@@ -1,0 +1,7 @@
+"""Core substrate: pytree math, aggregation primitives, partitioners, config.
+
+Replaces the reference's ``fedml_core`` package (SURVEY.md §2.1). Everything
+here is backend-agnostic pure math — no communication, no models.
+"""
+
+from fedml_tpu.core import aggregation, config, partition, pytree, rng, serialization  # noqa: F401

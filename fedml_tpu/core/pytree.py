@@ -1,0 +1,139 @@
+"""Pytree math utilities.
+
+The reference manipulates ``OrderedDict`` state_dicts with per-key Python
+loops (e.g. weighted averaging repeated verbatim in >=6 files,
+fedavg_api.py:100-115; weight vectorization robustness/robust_aggregation.py:4-9).
+Here every model/optimizer state is a JAX pytree and these helpers are the
+single shared vocabulary: they are jit-safe, differentiable where meaningful,
+and shape/dtype preserving.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+def tree_zeros_like(tree: Pytree) -> Pytree:
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_add(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: Pytree, b: Pytree) -> Pytree:
+    """a - b, leafwise. The FedOpt pseudo-gradient is tree_sub(global, avg)
+    (reference fedopt_api.py:139-152)."""
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(tree: Pytree, s) -> Pytree:
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+def tree_axpy(a, x: Pytree, y: Pytree) -> Pytree:
+    """a * x + y, leafwise."""
+    return jax.tree.map(lambda xi, yi: a * xi + yi, x, y)
+
+
+def tree_dot(a: Pytree, b: Pytree) -> jax.Array:
+    """Global inner product over all leaves."""
+    leaves = jax.tree.map(lambda x, y: jnp.vdot(x, y), a, b)
+    return jax.tree.reduce(jnp.add, leaves, jnp.zeros(()))
+
+
+def tree_vectorize(tree: Pytree) -> jax.Array:
+    """Flatten all leaves to one 1-D vector (reference
+    robust_aggregation.py:4-9 ``vectorize_weight``)."""
+    leaves = jax.tree.leaves(tree)
+    return jnp.concatenate([jnp.ravel(x) for x in leaves]) if leaves else jnp.zeros((0,))
+
+
+def tree_global_norm(tree: Pytree) -> jax.Array:
+    """L2 norm over every element of every leaf."""
+    sq = jax.tree.map(lambda x: jnp.sum(jnp.square(x.astype(jnp.float32))), tree)
+    return jnp.sqrt(jax.tree.reduce(jnp.add, sq, jnp.zeros(())))
+
+
+def tree_cast(tree: Pytree, dtype) -> Pytree:
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def tree_count_params(tree: Pytree) -> int:
+    return int(sum(np.prod(x.shape) for x in jax.tree.leaves(tree)))
+
+
+def tree_stack(trees: Sequence[Pytree]) -> Pytree:
+    """Stack a list of identically-structured pytrees along a new leading
+    axis — how a list of per-client states becomes one vmap-able batch."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_unstack(tree: Pytree, n: int) -> list[Pytree]:
+    """Inverse of :func:`tree_stack`."""
+    return [jax.tree.map(lambda x: x[i], tree) for i in range(n)]
+
+
+def tree_index(tree: Pytree, i) -> Pytree:
+    """Select index ``i`` along the leading axis of every leaf."""
+    return jax.tree.map(lambda x: x[i], tree)
+
+
+def tree_weighted_mean(stacked: Pytree, weights: jax.Array) -> Pytree:
+    """Weighted average along the leading (client) axis of every leaf.
+
+    This is THE aggregation primitive: the reference re-implements it as a
+    per-key dict loop in fedavg_api.py:100-115, FedAVGAggregator.py:58-87,
+    fedopt_api.py, fednova_trainer.py, silo_fedavg.py... Here it is one
+    einsum-shaped reduction that XLA maps onto the MXU/VPU.
+
+    Args:
+      stacked: pytree whose leaves have leading axis ``num_clients``.
+      weights: ``[num_clients]`` nonnegative; normalized internally.
+    """
+    w = weights.astype(jnp.float32)
+    w = w / jnp.maximum(jnp.sum(w), 1e-12)
+
+    def avg(x):
+        wb = w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(jnp.float32)
+        return jnp.sum(x.astype(jnp.float32) * wb, axis=0).astype(x.dtype)
+
+    return jax.tree.map(avg, stacked)
+
+
+def tree_weighted_sum_list(trees: Sequence[Pytree], weights: Sequence[float]) -> Pytree:
+    """Host-side weighted sum of a Python list of pytrees (normalized).
+
+    Convenience for algorithm code that holds results as a list (mirrors the
+    reference ``_aggregate`` signature, fedavg_api.py:100-115) without the
+    reference's in-place mutation bug of ``w_locals[0]``.
+    """
+    total = float(sum(weights))
+    out = tree_scale(trees[0], weights[0] / total)
+    for t, w in zip(trees[1:], weights[1:]):
+        out = tree_axpy(w / total, t, out)
+    return out
+
+
+def tree_map_with_path_filter(
+    fn: Callable[[jax.Array], jax.Array],
+    tree: Pytree,
+    path_pred: Callable[[str], bool],
+) -> Pytree:
+    """Apply ``fn`` only to leaves whose joined key-path satisfies ``path_pred``.
+
+    Used to skip non-weight leaves (e.g. BatchNorm running stats) the way the
+    reference's ``is_weight_param`` does (robust_aggregation.py:28-29).
+    """
+
+    def _fn(path, leaf):
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        return fn(leaf) if path_pred(name) else leaf
+
+    return jax.tree_util.tree_map_with_path(_fn, tree)

@@ -1,0 +1,45 @@
+"""Deterministic RNG plumbing.
+
+The reference seeds random/np/torch at each main (main_fedavg.py:313-316) and
+notoriously reseeds np.random with the round index inside client sampling
+(fedavg_api.py:83-91 ``np.random.seed(round_idx)``) so sampling is
+reproducible across runs. Here everything flows from one ``jax.random.key``;
+client sampling keys are derived by folding in the round index, which keeps
+the reference's "same round -> same sample" property without touching global
+state.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+
+
+def seed_everything(seed: int) -> jax.Array:
+    """Seed python/numpy global RNGs (for host-side shuffles in data loaders)
+    and return the root JAX key."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.key(seed)
+
+
+def round_key(root: jax.Array, round_idx: int) -> jax.Array:
+    """Per-round key; deterministic in (seed, round) like the reference's
+    per-round reseed (fedavg_api.py:87)."""
+    return jax.random.fold_in(root, round_idx)
+
+
+def client_keys(round_k: jax.Array, num_clients: int) -> jax.Array:
+    """[num_clients] keys for per-client dropout/shuffle inside one round."""
+    return jax.random.split(round_k, num_clients)
+
+
+def sample_clients(round_idx: int, client_num_in_total: int, client_num_per_round: int, seed: int = 0) -> np.ndarray:
+    """Round-deterministic client sampling without replacement
+    (reference _client_sampling, fedavg_api.py:83-91)."""
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total, dtype=np.int64)
+    rng = np.random.default_rng(seed * 1_000_003 + round_idx)
+    return np.sort(rng.choice(client_num_in_total, client_num_per_round, replace=False)).astype(np.int64)

@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors how the reference simulates multi-node MPI on a single host by
+listing localhost with many slots (fed_launch/README.md:11-27) — here the
+"nodes" are virtual XLA CPU devices so sharding/collective code paths run
+for real without TPU hardware.
+"""
+
+import os
+
+# Hard override: the ambient environment pins JAX_PLATFORMS to the real TPU
+# tunnel; unit tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The ambient TPU-tunnel integration force-sets jax_platforms="axon,cpu" via
+# jax.config at interpreter start (sitecustomize), which env vars alone can't
+# undo — counter-update so unit tests stay on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,191 @@
+"""Unit tests for the core substrate (the tests the reference lacks,
+SURVEY.md §4 implication: partitioner + aggregation math)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core import aggregation, partition, rng, serialization
+from fedml_tpu.core.pytree import (
+    tree_global_norm,
+    tree_stack,
+    tree_sub,
+    tree_unstack,
+    tree_vectorize,
+    tree_weighted_mean,
+    tree_weighted_sum_list,
+)
+
+
+def _params(seed, scale=1.0):
+    k = jax.random.key(seed)
+    k1, k2 = jax.random.split(k)
+    return {
+        "dense": {"kernel": scale * jax.random.normal(k1, (4, 3)), "bias": jnp.zeros((3,))},
+        "conv": {"kernel": scale * jax.random.normal(k2, (3, 3, 2, 5))},
+    }
+
+
+class TestTreeOps:
+    def test_weighted_mean_matches_manual(self):
+        trees = [_params(i) for i in range(3)]
+        w = jnp.array([1.0, 2.0, 3.0])
+        stacked = tree_stack(trees)
+        got = tree_weighted_mean(stacked, w)
+        want_kernel = sum(
+            wi * t["dense"]["kernel"] for wi, t in zip([1 / 6, 2 / 6, 3 / 6], trees)
+        )
+        np.testing.assert_allclose(got["dense"]["kernel"], want_kernel, rtol=1e-5)
+
+    def test_weighted_sum_list_no_mutation(self):
+        # The reference's _aggregate mutates w_locals[0] in place
+        # (fedavg_api.py:106-114); ours must not.
+        trees = [_params(i) for i in range(2)]
+        before = np.asarray(trees[0]["dense"]["kernel"]).copy()
+        tree_weighted_sum_list(trees, [1.0, 1.0])
+        np.testing.assert_array_equal(np.asarray(trees[0]["dense"]["kernel"]), before)
+
+    def test_stack_unstack_roundtrip(self):
+        trees = [_params(i) for i in range(4)]
+        out = tree_unstack(tree_stack(trees), 4)
+        np.testing.assert_allclose(out[2]["conv"]["kernel"], trees[2]["conv"]["kernel"])
+
+    def test_vectorize_and_norm(self):
+        t = _params(0)
+        v = tree_vectorize(t)
+        assert v.shape == (4 * 3 + 3 + 3 * 3 * 2 * 5,)
+        np.testing.assert_allclose(tree_global_norm(t), jnp.linalg.norm(v), rtol=1e-5)
+
+
+class TestAggregation:
+    def test_fedavg_weighted(self):
+        stacked = tree_stack([_params(0), _params(1)])
+        agg = aggregation.fedavg_aggregate(stacked, jnp.array([10.0, 30.0]))
+        want = 0.25 * _params(0)["dense"]["kernel"] + 0.75 * _params(1)["dense"]["kernel"]
+        np.testing.assert_allclose(agg["dense"]["kernel"], want, rtol=1e-5)
+
+    def test_norm_clip_bounds_update(self):
+        g = _params(0)
+        l = _params(1, scale=50.0)
+        clipped = aggregation.clip_update_by_norm(g, l, clip=1.0)
+        upd_norm = tree_global_norm(tree_sub(clipped, g))
+        assert float(upd_norm) <= 1.0 + 1e-4
+
+    def test_norm_clip_noop_when_small(self):
+        g = _params(0)
+        l = jax.tree.map(lambda x: x + 1e-4, g)
+        clipped = aggregation.clip_update_by_norm(g, l, clip=100.0)
+        np.testing.assert_allclose(clipped["dense"]["kernel"], l["dense"]["kernel"], rtol=1e-5)
+
+    def test_dp_noise_changes_weights(self):
+        g = _params(0)
+        noised = aggregation.add_dp_noise(g, 0.1, jax.random.key(7))
+        assert not np.allclose(noised["dense"]["kernel"], g["dense"]["kernel"])
+
+    def test_agc_clip(self):
+        g = _params(0)
+        l = _params(1, scale=100.0)
+        out = aggregation.agc_clip_update(g, l, clipping=1e-2)
+        # Update must be drastically shrunk relative to the raw diff.
+        raw = float(tree_global_norm(tree_sub(l, g)))
+        got = float(tree_global_norm(tree_sub(out, g)))
+        assert got < raw * 0.05
+
+    def test_hierarchical_matches_flat(self):
+        trees = [_params(i) for i in range(4)]
+        stacked = tree_stack(trees)
+        w = jnp.array([1.0, 2.0, 3.0, 4.0])
+        gids = jnp.array([0, 0, 1, 1])
+        _, glob = aggregation.hierarchical_aggregate(stacked, w, gids, 2)
+        flat = tree_weighted_mean(stacked, w)
+        np.testing.assert_allclose(glob["dense"]["kernel"], flat["dense"]["kernel"], rtol=1e-5)
+
+    def test_psum_weighted_average_on_mesh(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        devices = np.array(jax.devices()[:4])
+        mesh = Mesh(devices, ("c",))
+        stacked = tree_stack([_params(i) for i in range(4)])
+        w = jnp.array([1.0, 2.0, 3.0, 4.0])
+
+        @jax.jit
+        def run(stacked, w):
+            def f(local, wi):
+                return aggregation.psum_weighted_average(
+                    jax.tree.map(lambda x: x[0], local), wi[0], "c"
+                )
+            return shard_map(
+                f, mesh=mesh, in_specs=(P("c"), P("c")), out_specs=P()
+            )(stacked, w)
+
+        got = run(stacked, w)
+        want = tree_weighted_mean(stacked, w)
+        np.testing.assert_allclose(got["dense"]["kernel"], want["dense"]["kernel"], rtol=1e-4)
+
+
+class TestPartition:
+    def test_homo_covers_all(self):
+        m = partition.homo_partition(1000, 7, seed=1)
+        allidx = np.concatenate([m[i] for i in range(7)])
+        assert len(allidx) == 1000 and len(np.unique(allidx)) == 1000
+
+    def test_hetero_dirichlet_properties(self):
+        labels = np.random.default_rng(0).integers(0, 10, size=5000)
+        m = partition.hetero_partition(labels, 10, 10, alpha=0.5, seed=0)
+        allidx = np.concatenate([m[i] for i in range(10)])
+        assert len(np.unique(allidx)) == len(allidx) == 5000
+        assert min(len(m[i]) for i in range(10)) >= 10  # retry-loop floor
+
+    def test_hetero_is_nonuniform(self):
+        labels = np.random.default_rng(0).integers(0, 10, size=5000)
+        m = partition.hetero_partition(labels, 10, 10, alpha=0.1, seed=0)
+        stats = partition.record_data_stats(labels, m)
+        # With alpha=0.1 most clients should NOT hold all 10 classes uniformly.
+        class_counts = [len(stats[i]) for i in range(10)]
+        assert min(class_counts) < 10
+
+    def test_deterministic(self):
+        labels = np.random.default_rng(0).integers(0, 10, size=2000)
+        a = partition.hetero_partition(labels, 5, 10, 0.5, seed=3)
+        b = partition.hetero_partition(labels, 5, 10, 0.5, seed=3)
+        for i in range(5):
+            np.testing.assert_array_equal(a[i], b[i])
+
+
+class TestRng:
+    def test_sample_clients_deterministic_per_round(self):
+        a = rng.sample_clients(5, 100, 10, seed=0)
+        b = rng.sample_clients(5, 100, 10, seed=0)
+        np.testing.assert_array_equal(a, b)
+        c = rng.sample_clients(6, 100, 10, seed=0)
+        assert not np.array_equal(a, c)
+
+    def test_full_participation(self):
+        np.testing.assert_array_equal(rng.sample_clients(0, 8, 8), np.arange(8))
+
+
+class TestSerialization:
+    def test_roundtrip_bytes(self):
+        t = _params(3)
+        t2 = serialization.tree_from_bytes(serialization.tree_to_bytes(t))
+        assert jax.tree.structure(t2) == jax.tree.structure(jax.tree.map(np.asarray, t))
+        np.testing.assert_allclose(t2["conv"]["kernel"], t["conv"]["kernel"])
+
+    def test_roundtrip_with_tuples_and_none(self):
+        t = {"a": (jnp.ones((2, 2)), None, [jnp.zeros((3,))]), "b": jnp.arange(5)}
+        t2 = serialization.tree_from_bytes(serialization.tree_to_bytes(t))
+        np.testing.assert_array_equal(t2["a"][0], np.ones((2, 2)))
+        assert t2["a"][1] is None
+        np.testing.assert_array_equal(t2["b"], np.arange(5))
+
+    def test_mobile_json_roundtrip(self):
+        t = _params(1)
+        j = serialization.tree_to_jsonable(t)
+        back = serialization.tree_from_jsonable(j, t)
+        np.testing.assert_allclose(back["dense"]["kernel"], t["dense"]["kernel"], rtol=1e-6)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
