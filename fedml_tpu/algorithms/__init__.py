@@ -1,0 +1,8 @@
+"""FL algorithm zoo (counterpart of fedml_api/{standalone,distributed,centralized}).
+
+Every algorithm composes two primitives:
+- a jitted local-train function (fedml_tpu.parallel.local), and
+- an aggregation rule (fedml_tpu.core.aggregation),
+run either as vmap-over-clients simulation (standalone paradigm) or
+shard_map-over-mesh (cross-silo distributed paradigm).
+"""

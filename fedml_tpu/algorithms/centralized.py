@@ -1,0 +1,77 @@
+"""Centralized (non-FL) baseline trainer over the same federated dataset —
+the sanity baseline and the other half of the federated==centralized
+equivalence gate (reference fedml_api/centralized/centralized_trainer.py:9-104
+and CI-script-fedavg.sh:43-47).
+
+Implementation: the federation's records are merged into ONE logical client
+and trained with the same jitted local-train program — so the equivalence
+test compares two code paths that share only the math, not the loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.rng import round_key, seed_everything
+from fedml_tpu.core.tasks import get_task
+from fedml_tpu.data import FedDataset
+from fedml_tpu.data.batching import pad_to_multiple
+from fedml_tpu.models import ModelBundle, create_model
+from fedml_tpu.parallel.local import finalize_metrics, make_eval_fn, make_local_train_fn
+
+
+def merge_clients(dataset: FedDataset, batch_size: int):
+    """Flatten the stacked per-client arrays back into one masked pool."""
+    C, n_pad = dataset.train_mask.shape
+    flat_x = dataset.train_x.reshape((C * n_pad,) + dataset.train_x.shape[2:])
+    flat_y = dataset.train_y.reshape((C * n_pad,) + dataset.train_y.shape[2:])
+    flat_m = dataset.train_mask.reshape(-1)
+    keep = flat_m > 0
+    x, y = flat_x[keep], flat_y[keep]
+    n = pad_to_multiple(len(x), batch_size)
+    pad = n - len(x)
+    if pad:
+        x = np.concatenate([x, x[:pad]])
+        y = np.concatenate([y, y[:pad]])
+    m = np.concatenate([np.ones(len(flat_m[keep]), np.float32), np.zeros(pad, np.float32)])
+    return x, y, m
+
+
+class CentralizedTrainer:
+    def __init__(self, dataset: FedDataset, config: FedConfig, bundle: ModelBundle | None = None):
+        self.dataset = dataset
+        self.config = config
+        self.bundle = bundle or create_model(
+            config.model, dataset.class_num, input_shape=dataset.train_x.shape[2:] or None
+        )
+        self.task = get_task(dataset.task)
+        self.root_key = seed_everything(config.seed)
+        self.variables = self.bundle.init(self.root_key)
+        self.x, self.y, self.mask = merge_clients(dataset, config.batch_size)
+        self._train = jax.jit(make_local_train_fn(
+            self.bundle, self.task,
+            optimizer=config.client_optimizer, lr=config.lr, momentum=config.momentum,
+            wd=config.wd, epochs=config.epochs, batch_size=config.batch_size,
+            grad_clip=config.grad_clip,
+        ))
+        self._eval = make_eval_fn(self.bundle, self.task)
+
+    def train(self) -> dict:
+        history = {"round": [], "Test/Acc": [], "Test/Loss": []}
+        for r in range(self.config.comm_round):
+            res = self._train(
+                self.variables, jnp.asarray(self.x), jnp.asarray(self.y),
+                jnp.asarray(self.mask), round_key(self.root_key, r),
+            )
+            self.variables = res.variables
+            if r % self.config.frequency_of_the_test == 0 or r == self.config.comm_round - 1:
+                m = finalize_metrics(jax.tree.map(np.asarray, self._eval(
+                    self.variables, self.dataset.test_x, self.dataset.test_y, self.dataset.test_mask
+                )))
+                history["round"].append(r)
+                history["Test/Acc"].append(m.get("acc"))
+                history["Test/Loss"].append(m.get("loss"))
+        return history

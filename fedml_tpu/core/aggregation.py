@@ -21,15 +21,19 @@ import jax.numpy as jnp
 
 from fedml_tpu.core.pytree import (
     Pytree,
+    path_str,
     tree_global_norm,
     tree_map_with_path_filter,
     tree_weighted_mean,
+    tree_zero_by_path,
 )
 
 # Leaves whose key path contains one of these fragments are treated as
 # non-weight statistics (BatchNorm running mean/var) and are averaged but
 # never clipped/noised — mirrors is_weight_param (robust_aggregation.py:28-29).
-NON_WEIGHT_KEY_FRAGMENTS = ("batch_stats", "mean", "var", "num_batches_tracked")
+# Precise fragments only: a weight legitimately named e.g. 'mean_head' must
+# NOT be excluded. Flax puts BN stats under 'batch_stats/'.
+NON_WEIGHT_KEY_FRAGMENTS = ("batch_stats", "running_mean", "running_var", "num_batches_tracked")
 
 
 def is_weight_path(path: str) -> bool:
@@ -50,29 +54,25 @@ def clip_update_by_norm(global_params: Pytree, local_params: Pytree, clip: float
     re-add. Reference: RobustAggregator.norm_diff_clipping
     (robust_aggregation.py:38-49), applied only to weight leaves."""
     diff = jax.tree.map(jnp.subtract, local_params, global_params)
-    weight_diff = tree_map_with_path_filter(lambda x: x, diff, is_weight_path)
-    norm = tree_global_norm(weight_diff)
+    norm = tree_global_norm(tree_zero_by_path(diff, is_weight_path))
     scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
     clipped = tree_map_with_path_filter(lambda x: x * scale, diff, is_weight_path)
     return jax.tree.map(jnp.add, global_params, clipped)
 
 
 def add_dp_noise(params: Pytree, stddev: float, rng: jax.Array) -> Pytree:
-    """Add i.i.d. gaussian noise to weight leaves (weak DP defense,
-    robust_aggregation.py:51-55)."""
-    leaves, treedef = jax.tree.flatten(params)
-    keys = list(jax.random.split(rng, len(leaves)))
-    noised = []
-    for leaf, key in zip(leaves, keys):
-        noised.append(leaf + stddev * jax.random.normal(key, leaf.shape, leaf.dtype))
-    cand = jax.tree.unflatten(treedef, noised)
-    # Only weight leaves get noise; stats pass through untouched.
-    paths = jax.tree_util.tree_flatten_with_path(params)[0]
-    out_leaves = []
-    for (path, orig), noisy in zip(paths, jax.tree.leaves(cand)):
-        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        out_leaves.append(noisy if is_weight_path(name) else orig)
-    return jax.tree.unflatten(treedef, out_leaves)
+    """Add i.i.d. gaussian noise to float weight leaves (weak DP defense,
+    robust_aggregation.py:51-55). Stats and integer leaves (e.g. step
+    counters) pass through untouched. Single traversal."""
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for i, (path, leaf) in enumerate(leaves_with_path):
+        if is_weight_path(path_str(path)) and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            key = jax.random.fold_in(rng, i)
+            out.append(leaf + stddev * jax.random.normal(key, leaf.shape, leaf.dtype))
+        else:
+            out.append(leaf)
+    return jax.tree.unflatten(treedef, out)
 
 
 def unitwise_norm(x: jax.Array) -> jax.Array:

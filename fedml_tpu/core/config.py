@@ -169,6 +169,8 @@ def config_from_args(args: argparse.Namespace) -> FedConfig:
     yaml_path = d.pop("config_yaml", None)
     cfg = FedConfig.from_dict(d)
     if yaml_path:
+        if yaml is None:
+            raise RuntimeError("pyyaml not available but --config_yaml was passed")
         base = cfg.to_dict()
         with open(yaml_path) as f:
             base.update(yaml.safe_load(f) or {})

@@ -50,7 +50,13 @@ def partition_class_samples_with_dirichlet_distribution(
     proportions = np.array(
         [p * (len(idx_j) < N / client_num) for p, idx_j in zip(proportions, idx_batch)]
     )
-    proportions = proportions / proportions.sum()
+    total = proportions.sum()
+    if total <= 0:
+        # every client at capacity: spread this class uniformly instead of
+        # dividing by zero (NaN cascade in the reference's version)
+        proportions = np.full(client_num, 1.0 / client_num)
+    else:
+        proportions = proportions / total
     proportions = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
     idx_batch = [idx_j + idx.tolist() for idx_j, idx in zip(idx_batch, np.split(idx_k, proportions))]
     min_size = min(len(idx_j) for idx_j in idx_batch)
@@ -73,7 +79,19 @@ def non_iid_partition_with_dirichlet_distribution(
     rng = np.random.default_rng(seed)
     min_size = 0
     N = len(label_list)
+    # Feasibility: with the capacity balancing, no client can exceed
+    # N/client_num samples, and Dirichlet draws rarely give every client the
+    # exact cap — clamp the floor to a reliably attainable level rather than
+    # spin forever (the reference's retry loop hangs when N/client_num < 10).
+    min_size_floor = max(1, min(min_size_floor, N // (client_num * 10)))
+    attempts = 0
     while min_size < min_size_floor:
+        attempts += 1
+        if attempts > 1000:
+            raise RuntimeError(
+                f"Dirichlet partition failed to reach min size {min_size_floor} "
+                f"after 1000 attempts (N={N}, clients={client_num}, alpha={alpha})"
+            )
         idx_batch: list[list[int]] = [[] for _ in range(client_num)]
         for k in range(classes):
             if task == "segmentation":

@@ -121,19 +121,35 @@ def tree_weighted_sum_list(trees: Sequence[Pytree], weights: Sequence[float]) ->
     return out
 
 
+def path_str(path) -> str:
+    """Join a jax key-path to 'a/b/c' (single definition shared by the
+    aggregation and serialization modules)."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
 def tree_map_with_path_filter(
     fn: Callable[[jax.Array], jax.Array],
     tree: Pytree,
     path_pred: Callable[[str], bool],
 ) -> Pytree:
-    """Apply ``fn`` only to leaves whose joined key-path satisfies ``path_pred``.
+    """Apply ``fn`` only to leaves whose joined key-path satisfies ``path_pred``;
+    other leaves pass through unchanged.
 
     Used to skip non-weight leaves (e.g. BatchNorm running stats) the way the
     reference's ``is_weight_param`` does (robust_aggregation.py:28-29).
     """
 
     def _fn(path, leaf):
-        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        return fn(leaf) if path_pred(name) else leaf
+        return fn(leaf) if path_pred(path_str(path)) else leaf
+
+    return jax.tree_util.tree_map_with_path(_fn, tree)
+
+
+def tree_zero_by_path(tree: Pytree, path_pred: Callable[[str], bool]) -> Pytree:
+    """Zero out leaves whose path does NOT satisfy ``path_pred`` (so norms /
+    reductions see only the selected leaves)."""
+
+    def _fn(path, leaf):
+        return leaf if path_pred(path_str(path)) else jnp.zeros_like(leaf)
 
     return jax.tree_util.tree_map_with_path(_fn, tree)

@@ -74,6 +74,13 @@ def _treedef_to_json(treedef) -> Any:
 
 def _pyify(x):
     if isinstance(x, dict):
+        for k in x:
+            if not isinstance(k, str):
+                # JSON would stringify the key and jax's key-sorted flatten
+                # order would then silently reassign leaves — refuse instead.
+                raise TypeError(
+                    f"wire pytrees require string dict keys, got {type(k).__name__} {k!r}"
+                )
         return {"__d__": {k: _pyify(v) for k, v in x.items()}}
     if isinstance(x, tuple):
         return {"__t__": [_pyify(v) for v in x]}

@@ -1,0 +1,95 @@
+"""Federated data layer.
+
+The reference's loader contract is an 8-tuple of torch DataLoaders
+(train_data_num, test_data_num, train_data_global, test_data_global,
+train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+class_num) — cifar10/data_loader.py:235-269. A DataLoader-per-client is
+hostile to XLA: ragged shapes, Python iteration, per-batch host->device hops.
+
+The TPU-native contract is :class:`FedDataset`: every client's records are
+padded to one static shape and stacked along a leading client axis, with a
+mask marking real records. One ``vmap``/``shard_map`` then trains all
+clients without a single dynamic shape. Loaders register under the
+reference's --dataset names.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+_LOADERS: dict[str, Callable[..., "FedDataset"]] = {}
+
+
+def register_dataset(*names: str):
+    def deco(fn):
+        for n in names:
+            _LOADERS[n] = fn
+        return fn
+    return deco
+
+
+@dataclass
+class FedDataset:
+    """Stacked, padded, mask-aware federated dataset (host numpy; algorithms
+    move slices to device per round)."""
+
+    # per-client train data: leaves [num_clients, n_pad, ...]
+    train_x: np.ndarray
+    train_y: np.ndarray
+    train_mask: np.ndarray          # [num_clients, n_pad] {0,1}
+    train_counts: np.ndarray        # [num_clients] true record counts
+    # global test pool (already padded to a batch multiple by loaders)
+    test_x: np.ndarray
+    test_y: np.ndarray
+    test_mask: np.ndarray           # [n_test_pad]
+    class_num: int
+    task: str = "classification"
+    # optional per-client test split (cross-device eval), same stacked scheme
+    test_x_local: Optional[np.ndarray] = None
+    test_y_local: Optional[np.ndarray] = None
+    test_mask_local: Optional[np.ndarray] = None
+    name: str = ""
+
+    @property
+    def num_clients(self) -> int:
+        return int(self.train_x.shape[0])
+
+    @property
+    def train_data_num(self) -> int:
+        return int(self.train_counts.sum())
+
+    @property
+    def test_data_num(self) -> int:
+        return int(self.test_mask.sum())
+
+    def client_slice(self, idx: np.ndarray):
+        """Gather sampled clients' arrays (host-side; the result ships to
+        device once per round — the only host->device transfer in a round)."""
+        return (
+            self.train_x[idx],
+            self.train_y[idx],
+            self.train_mask[idx],
+            self.train_counts[idx],
+        )
+
+
+def load_dataset(name: str, **kw) -> FedDataset:
+    """Dispatch on the reference's --dataset flag values (mnist, femnist,
+    shakespeare, fed_shakespeare, fed_cifar100, stackoverflow_lr,
+    stackoverflow_nwp, cifar10, cifar100, cinic10, synthetic_1_1, ...)."""
+    from fedml_tpu.data import (  # noqa: F401
+        cifar, femnist, mnist, shakespeare, stackoverflow, synthetic,
+    )
+    if name not in _LOADERS:
+        raise KeyError(f"unknown dataset {name!r}; known: {sorted(_LOADERS)}")
+    return _LOADERS[name](**kw)
+
+
+def known_datasets() -> list[str]:
+    from fedml_tpu.data import (  # noqa: F401
+        cifar, femnist, mnist, shakespeare, stackoverflow, synthetic,
+    )
+    return sorted(_LOADERS)

@@ -1,0 +1,84 @@
+"""StackOverflow datasets — logistic-regression tag prediction and next-word
+prediction (reference fedml_api/data_preprocessing/stackoverflow_lr/
+data_loader.py:25-130 and stackoverflow_nwp/, TFF h5, 342,477 clients).
+
+The full corpus is ~342k clients; loaders take ``client_num_in_total`` as the
+cap (the reference samples 50/round out of the full set). Synthetic fallback
+mirrors shapes: LR = 10k-dim bag-of-words -> 500 multilabel tags; NWP =
+token sequences of length 20 over a 10004-word vocab.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from fedml_tpu.data import FedDataset, register_dataset
+from fedml_tpu.data.batching import pad_and_stack_clients, pad_eval_pool
+
+WORD_DIM = 10000
+TAG_DIM = 500
+NWP_SEQ = 20
+NWP_VOCAB = 10004
+
+
+def _synthetic_so_lr(num_clients: int, batch_size: int, seed: int) -> FedDataset:
+    rng = np.random.default_rng(seed)
+    # low-rank word->tag structure so the linear model learns
+    proj = rng.normal(0, 1, (WORD_DIM, TAG_DIM)).astype(np.float32)
+    xs, ys = [], []
+    for c in range(num_clients):
+        n = int(rng.integers(8, 40))
+        x = (rng.random((n, WORD_DIM)) < 0.002).astype(np.float32)
+        scores = x @ proj
+        y = (scores > np.quantile(scores, 0.99, axis=1, keepdims=True)).astype(np.float32)
+        xs.append(x); ys.append(y)
+    tx, ty, tm, tc = pad_and_stack_clients(xs, ys, batch_size)
+    ex, ey, em = pad_eval_pool(np.concatenate(xs)[:512], np.concatenate(ys)[:512], 128)
+    return FedDataset(
+        train_x=tx, train_y=ty, train_mask=tm, train_counts=tc,
+        test_x=ex, test_y=ey, test_mask=em, class_num=TAG_DIM,
+        task="tag_prediction", name="stackoverflow_lr(synthetic)",
+    )
+
+
+@register_dataset("stackoverflow_lr")
+def load_stackoverflow_lr(
+    data_dir: str = "./data/stackoverflow",
+    client_num_in_total: int = 100,
+    batch_size: int = 10,
+    seed: int = 0,
+    **_,
+) -> FedDataset:
+    h5 = os.path.join(data_dir, "stackoverflow_train.h5")
+    if not os.path.exists(h5):
+        return _synthetic_so_lr(min(client_num_in_total, 100), batch_size, seed)
+    raise NotImplementedError(
+        "real stackoverflow_lr requires the TFF h5 + vocab/tag tables; "
+        "mount them under data_dir (see reference stackoverflow_lr/data_loader.py)"
+    )
+
+
+def _synthetic_so_nwp(num_clients: int, batch_size: int, seed: int) -> FedDataset:
+    from fedml_tpu.data.shakespeare import _synthetic_nwp
+
+    ds = _synthetic_nwp("stackoverflow_nwp(synthetic)", num_clients, NWP_VOCAB, NWP_SEQ, batch_size, seed)
+    return ds
+
+
+@register_dataset("stackoverflow_nwp")
+def load_stackoverflow_nwp(
+    data_dir: str = "./data/stackoverflow",
+    client_num_in_total: int = 100,
+    batch_size: int = 16,
+    seed: int = 0,
+    **_,
+) -> FedDataset:
+    h5 = os.path.join(data_dir, "stackoverflow_train.h5")
+    if not os.path.exists(h5):
+        return _synthetic_so_nwp(min(client_num_in_total, 100), batch_size, seed)
+    raise NotImplementedError(
+        "real stackoverflow_nwp requires the TFF h5 + vocab tables; "
+        "mount them under data_dir (see reference stackoverflow_nwp/data_loader.py)"
+    )

@@ -1,0 +1,85 @@
+"""Model zoo registry.
+
+Counterpart of ``fedml_api/model/`` + the ``create_model`` factory embedded in
+every reference main (fedml_experiments/distributed/fedavg/main_fedavg.py:232-267).
+Models are flax modules; ``create_model(name, ...)`` returns a ``ModelBundle``
+with pure init/apply functions so algorithms never touch module objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_REGISTRY: dict[str, Callable[..., "ModelBundle"]] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+@dataclass
+class ModelBundle:
+    """A model as pure functions over variable pytrees.
+
+    ``variables`` is the full flax collection dict {'params': ..., maybe
+    'batch_stats': ...}. ``apply_train`` returns (logits, new_variables) with
+    mutable collections updated; ``apply_eval`` is deterministic.
+    """
+
+    name: str
+    module: nn.Module
+    input_shape: tuple          # single-example shape, no batch dim
+    input_dtype: Any = jnp.float32
+    task: str = "classification"
+    has_batch_stats: bool = False
+    uses_dropout: bool = False
+
+    def init(self, rng: jax.Array, batch_size: int = 2) -> dict:
+        x = jnp.zeros((batch_size,) + tuple(self.input_shape), self.input_dtype)
+        return self.module.init({"params": rng}, x, train=False)
+
+    def apply_train(self, variables: dict, x: jax.Array, rng: jax.Array):
+        rngs = {"dropout": rng} if self.uses_dropout else {}
+        if self.has_batch_stats:
+            logits, updated = self.module.apply(
+                variables, x, train=True, mutable=["batch_stats"], rngs=rngs
+            )
+            new_vars = dict(variables)
+            new_vars.update(updated)
+            return logits, new_vars
+        out = self.module.apply(variables, x, train=True, rngs=rngs)
+        return out, variables
+
+    def apply_eval(self, variables: dict, x: jax.Array) -> jax.Array:
+        return self.module.apply(variables, x, train=False)
+
+
+def create_model(model_name: str, output_dim: int, input_shape: Optional[Sequence[int]] = None, **kw) -> ModelBundle:
+    """Factory keyed by the reference's --model flag values
+    (main_fedavg.py:232-267: lr, cnn, resnet18_gn, rnn, resnet56, mobilenet,
+    ...)."""
+    # Import lazily so optional model families don't slow cold start.
+    from fedml_tpu.models import cnn, linear, mobilenet, resnet, resnet_gn, rnn, vgg  # noqa: F401
+    try:
+        from fedml_tpu.models import efficientnet  # noqa: F401
+    except ImportError:
+        pass
+    if model_name not in _REGISTRY:
+        raise KeyError(f"unknown model {model_name!r}; known: {sorted(_REGISTRY)}")
+    bundle = _REGISTRY[model_name](output_dim=output_dim, **kw)
+    if input_shape is not None:
+        bundle.input_shape = tuple(input_shape)
+    return bundle
+
+
+def known_models() -> list[str]:
+    from fedml_tpu.models import cnn, linear, mobilenet, resnet, resnet_gn, rnn, vgg  # noqa: F401
+    return sorted(_REGISTRY)

@@ -1,0 +1,71 @@
+"""FedAvg-paper CNNs for FEMNIST/MNIST (reference fedml_api/model/cv/cnn.py:5-142).
+
+Two variants, matching the reference capabilities:
+
+- ``cnn`` / CNN_OriginalFedAvg (cnn.py:5-70): 2x[conv5x5 -> maxpool2] ->
+  dense(512) -> softmax head, McMahan et al. 2016 table 2 sizing.
+- ``cnn_dropout`` / CNN_DropOut (cnn.py:74-142): the TFF baseline flavor with
+  3x3 convs and dropout.
+
+NHWC layout (TPU-native; torch reference is NCHW).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+
+
+class CNNOriginalFedAvg(nn.Module):
+    output_dim: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:  # flat 784 -> 28x28x1
+            x = x.reshape((x.shape[0], 28, 28, 1))
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(self.output_dim)(x)
+
+
+class CNNDropOut(nn.Module):
+    output_dim: int = 62
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 28, 28, 1))
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.output_dim)(x)
+
+
+@register_model("cnn")
+def _cnn(output_dim: int, **_):
+    return ModelBundle(
+        name="cnn",
+        module=CNNOriginalFedAvg(output_dim),
+        input_shape=(28, 28, 1),
+    )
+
+
+@register_model("cnn_dropout")
+def _cnn_dropout(output_dim: int, **_):
+    return ModelBundle(
+        name="cnn_dropout",
+        module=CNNDropOut(output_dim),
+        input_shape=(28, 28, 1),
+        uses_dropout=True,
+    )

@@ -1,0 +1,34 @@
+"""Linear models (reference fedml_api/model/linear/lr.py:4-11)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+
+
+class LogisticRegression(nn.Module):
+    """Single dense layer; logits out (loss applies softmax/sigmoid).
+
+    The reference applies torch.sigmoid at the output (lr.py:10) and pairs it
+    with CrossEntropyLoss anyway; we output raw logits, the numerically sound
+    equivalent.
+    """
+
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        return nn.Dense(self.output_dim, name="linear")(x)
+
+
+@register_model("lr")
+def _lr(output_dim: int, input_dim: int = 784, task: str = "classification", **_):
+    return ModelBundle(
+        name="lr",
+        module=LogisticRegression(output_dim),
+        input_shape=(input_dim,),
+        task=task,
+    )

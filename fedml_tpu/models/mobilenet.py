@@ -1,0 +1,164 @@
+"""MobileNet v1 and v3 (reference fedml_api/model/cv/mobilenet.py:1-209,
+cv/mobilenet_v3.py:1-257), CIFAR-sized.
+
+Depthwise-separable convs map well onto TPU: the depthwise stage runs on the
+VPU, pointwise 1x1 convs are MXU matmuls. NHWC throughout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+
+
+class DepthwiseSeparable(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=self.dtype)
+        cin = x.shape[-1]
+        x = nn.Conv(cin, (3, 3), strides=(self.strides, self.strides), padding="SAME",
+                    feature_group_count=cin, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(norm()(x))
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        return nn.relu(norm()(x))
+
+
+class MobileNetV1(nn.Module):
+    """Standard v1 stack (channel, stride) schedule, CIFAR stem (stride 1)."""
+
+    output_dim: int = 10
+    width: float = 1.0
+    dtype: Any = jnp.float32
+    schedule: Sequence[tuple] = (
+        (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+        (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1),
+    )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(int(32 * self.width), (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(x))
+        for ch, s in self.schedule:
+            x = DepthwiseSeparable(int(ch * self.width), s, dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def hard_sigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def hard_swish(x):
+    return x * hard_sigmoid(x)
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(c // self.reduce, 8), dtype=self.dtype)(s))
+        s = hard_sigmoid(nn.Dense(c, dtype=self.dtype)(s))
+        return x * s[:, None, None, :]
+
+
+class InvertedResidual(nn.Module):
+    exp: int
+    filters: int
+    kernel: int
+    strides: int
+    use_se: bool
+    use_hs: bool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=self.dtype)
+        act = hard_swish if self.use_hs else nn.relu
+        inp = x
+        cin = x.shape[-1]
+        y = x
+        if self.exp != cin:
+            y = nn.Conv(self.exp, (1, 1), use_bias=False, dtype=self.dtype)(y)
+            y = act(norm()(y))
+        y = nn.Conv(self.exp, (self.kernel, self.kernel), strides=(self.strides, self.strides),
+                    padding="SAME", feature_group_count=self.exp, use_bias=False, dtype=self.dtype)(y)
+        y = act(norm()(y))
+        if self.use_se:
+            y = SqueezeExcite(dtype=self.dtype)(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm()(y)
+        if self.strides == 1 and cin == self.filters:
+            y = y + inp
+        return y
+
+
+# (kernel, exp, out, SE, HS, stride) — v3-large / v3-small schedules
+_V3_LARGE = (
+    (3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2), (3, 72, 24, False, False, 1),
+    (5, 72, 40, True, False, 2), (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1), (3, 184, 80, False, True, 1),
+    (3, 184, 80, False, True, 1), (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1), (5, 960, 160, True, True, 1),
+)
+_V3_SMALL = (
+    (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2), (3, 88, 24, False, False, 1),
+    (5, 96, 40, True, True, 2), (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1), (5, 288, 96, True, True, 2),
+    (5, 576, 96, True, True, 1), (5, 576, 96, True, True, 1),
+)
+
+
+class MobileNetV3(nn.Module):
+    output_dim: int = 10
+    mode: str = "small"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        sched = _V3_LARGE if self.mode == "large" else _V3_SMALL
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), strides=(1, 1), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = hard_swish(norm()(x))
+        for k, exp, out, se, hs, s in sched:
+            x = InvertedResidual(exp, out, k, s, se, hs, dtype=self.dtype)(x, train=train)
+        last = 960 if self.mode == "large" else 576
+        x = nn.Conv(last, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = hard_swish(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = hard_swish(nn.Dense(1280 if self.mode == "large" else 1024, dtype=self.dtype)(x))
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+@register_model("mobilenet")
+def _mobilenet(output_dim: int, dtype=jnp.float32, **_):
+    return ModelBundle(
+        name="mobilenet",
+        module=MobileNetV1(output_dim, dtype=dtype),
+        input_shape=(32, 32, 3),
+        has_batch_stats=True,
+    )
+
+
+@register_model("mobilenet_v3")
+def _mobilenet_v3(output_dim: int, mode: str = "small", dtype=jnp.float32, **_):
+    return ModelBundle(
+        name="mobilenet_v3",
+        module=MobileNetV3(output_dim, mode=mode, dtype=dtype),
+        input_shape=(32, 32, 3),
+        has_batch_stats=True,
+    )

@@ -1,0 +1,96 @@
+"""CIFAR ResNets (ResNet-56/110) — the cross-silo flagship.
+
+Counterpart of reference fedml_api/model/cv/resnet.py (resnet56 factory):
+3 stages of BasicBlocks (depth = 6n+2), widths 16/32/64, BatchNorm + ReLU,
+option A/B shortcut = 1x1 conv projection when shape changes.
+
+TPU notes: NHWC layout, bf16-friendly (params fp32, compute dtype pluggable),
+BatchNorm uses flax 'batch_stats' collection which the federated trainers
+average like any other leaf (FedAvg averages running stats too).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding="SAME")(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), strides=(self.strides, self.strides))(x)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class CifarResNet(nn.Module):
+    """depth = 6n+2; blocks_per_stage = n."""
+
+    blocks_per_stage: int
+    output_dim: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(x))
+        for stage, filters in enumerate((16, 32, 64)):
+            for block in range(self.blocks_per_stage):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(filters, strides, dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def _make(depth: int, output_dim: int, dtype=jnp.float32) -> CifarResNet:
+    assert (depth - 2) % 6 == 0, "CIFAR ResNet depth must be 6n+2"
+    return CifarResNet((depth - 2) // 6, output_dim, dtype=dtype)
+
+
+@register_model("resnet56")
+def _resnet56(output_dim: int, dtype=jnp.float32, **_):
+    return ModelBundle(
+        name="resnet56",
+        module=_make(56, output_dim, dtype),
+        input_shape=(32, 32, 3),
+        has_batch_stats=True,
+    )
+
+
+@register_model("resnet110")
+def _resnet110(output_dim: int, dtype=jnp.float32, **_):
+    return ModelBundle(
+        name="resnet110",
+        module=_make(110, output_dim, dtype),
+        input_shape=(32, 32, 3),
+        has_batch_stats=True,
+    )
+
+
+@register_model("resnet20")
+def _resnet20(output_dim: int, dtype=jnp.float32, **_):
+    """Small variant for CI/tests (not in the reference zoo but same family)."""
+    return ModelBundle(
+        name="resnet20",
+        module=_make(20, output_dim, dtype),
+        input_shape=(32, 32, 3),
+        has_batch_stats=True,
+    )

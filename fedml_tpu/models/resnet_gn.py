@@ -1,0 +1,66 @@
+"""ResNet-18 with GroupNorm for fed_cifar100 cross-device FedAvg.
+
+Counterpart of reference fedml_api/model/cv/resnet_gn.py +
+cv/group_normalization.py: the TFF baseline replaces BatchNorm with
+GroupNorm(2 groups) so there is no cross-client batch statistic — the right
+choice for federated averaging and also stateless (pure params) on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+
+
+class GNBasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    groups: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        gn = partial(nn.GroupNorm, num_groups=self.groups, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding="SAME")(x)
+        y = nn.relu(gn()(y))
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
+        y = gn()(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), strides=(self.strides, self.strides))(x)
+            residual = gn()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18GN(nn.Module):
+    output_dim: int = 100
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    groups: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=self.groups, dtype=self.dtype)(x))
+        for stage, (filters, nblocks) in enumerate(zip((64, 128, 256, 512), self.stage_sizes)):
+            for block in range(nblocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = GNBasicBlock(filters, strides, self.groups, dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+@register_model("resnet18_gn")
+def _resnet18_gn(output_dim: int, dtype=jnp.float32, **_):
+    return ModelBundle(
+        name="resnet18_gn",
+        module=ResNet18GN(output_dim, dtype=dtype),
+        input_shape=(24, 24, 3),  # fed_cifar100 crops to 24x24 (TFF preprocessing)
+    )

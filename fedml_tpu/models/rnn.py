@@ -1,0 +1,69 @@
+"""Recurrent models for federated NLP (reference fedml_api/model/nlp/rnn.py:4-70).
+
+- ``rnn`` / RNN_OriginalFedAvg: embed(8) -> 2xLSTM(256) -> dense(vocab) for
+  char-level Shakespeare next-char prediction (seq len 80).
+- ``rnn_stackoverflow`` / RNN_StackOverFlow: embed(96) -> LSTM(670) ->
+  dense(96) -> dense(vocab+special) for StackOverflow next-word prediction.
+
+Outputs logits for EVERY position [B, T, V] (the reference returns the full
+sequence too) — pairs with the ``nwp`` task. lax.scan-based flax RNN keeps
+the compiled graph static-shaped for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+
+
+class CharLSTM(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class StackOverflowNWP(nn.Module):
+    # 10000 words + 4 special tokens (pad/bos/eos/oov), per the TFF baseline.
+    vocab_size: int = 10004
+    embedding_dim: int = 96
+    hidden: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+@register_model("rnn")
+def _rnn(output_dim: int = 90, seq_len: int = 80, **_):
+    return ModelBundle(
+        name="rnn",
+        module=CharLSTM(vocab_size=output_dim or 90),
+        input_shape=(seq_len,),
+        input_dtype=jnp.int32,
+        task="nwp",
+    )
+
+
+@register_model("rnn_stackoverflow")
+def _rnn_so(output_dim: int = 10004, seq_len: int = 20, **_):
+    return ModelBundle(
+        name="rnn_stackoverflow",
+        module=StackOverflowNWP(vocab_size=output_dim or 10004),
+        input_shape=(seq_len,),
+        input_dtype=jnp.int32,
+        task="nwp",
+    )

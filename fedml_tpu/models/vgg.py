@@ -1,0 +1,62 @@
+"""VGG (reference fedml_api/model/cv/vgg.py), CIFAR-sized, NHWC."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+
+_CFG: dict[str, Sequence] = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"),
+    "vgg19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence
+    output_dim: int = 10
+    use_bn: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME", use_bias=not self.use_bn, dtype=self.dtype)(x)
+                if self.use_bn:
+                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def _bundle(name: str, output_dim: int, dtype):
+    return ModelBundle(
+        name=name,
+        module=VGG(_CFG[name], output_dim, dtype=dtype),
+        input_shape=(32, 32, 3),
+        has_batch_stats=True,
+    )
+
+
+@register_model("vgg11")
+def _vgg11(output_dim: int, dtype=jnp.float32, **_):
+    return _bundle("vgg11", output_dim, dtype)
+
+
+@register_model("vgg16")
+def _vgg16(output_dim: int, dtype=jnp.float32, **_):
+    return _bundle("vgg16", output_dim, dtype)
+
+
+@register_model("vgg19")
+def _vgg19(output_dim: int, dtype=jnp.float32, **_):
+    return _bundle("vgg19", output_dim, dtype)

@@ -1,0 +1,11 @@
+"""Parallel execution paradigms.
+
+- ``local``: the jit-compiled per-client local training step (lax.scan over
+  epochs x batches) — replaces the reference's Python epoch/batch hot loop
+  (my_model_trainer_classification.py:19-53).
+- ``sim``: vmap-over-clients standalone simulation (replaces the sequential
+  client loop, fedavg_api.py:55-66).
+- ``crosssilo``: shard_map client-per-device over a Mesh with psum
+  aggregation (replaces the MPI star protocol, SURVEY.md §3.2).
+- ``mesh``: mesh construction helpers (single axis, hierarchical two-level).
+"""

@@ -1,0 +1,192 @@
+"""Per-client local training as one compiled program.
+
+The reference's innermost hot loop is Python: for epoch / for batch /
+loss.backward() / optimizer.step() (my_model_trainer_classification.py:19-53),
+with a host->device transfer per batch and a .cpu() state-dict copy per client
+(:12-14). Here the WHOLE local training run — E epochs of S minibatch steps
+with per-epoch reshuffling — is a single jitted ``lax.scan`` program, so one
+dispatch trains a client, and ``vmap``/``shard_map`` of the same function
+trains a whole cohort.
+
+Supports every trainer variant the algorithms need:
+- plain SGD/momentum/Adam (OptRepo counterpart is optax, fedopt/optrepo.py),
+- local gradient clipping (reference clips at 1.0, my_model_trainer:40),
+- FedProx proximal term mu/2 ||w - w_global||^2 — the term the reference
+  advertises but never implements (SURVEY.md §2.2 FedProx WARNING),
+- step counting (tau) for FedNova normalized averaging.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.core.pytree import Pytree, tree_dot, tree_sub
+from fedml_tpu.core.tasks import Task
+from fedml_tpu.models import ModelBundle
+
+
+def make_optimizer(
+    name: str, lr: float, momentum: float = 0.0, wd: float = 0.0
+) -> optax.GradientTransformation:
+    """Client optimizer factory; torch semantics (wd folded into the gradient
+    before momentum/moments, like torch.optim.SGD/Adam weight_decay). The
+    reference resolves optimizers by reflection over torch.optim subclasses
+    (fedopt/optrepo.py:11-39); optax names fill that role."""
+    chain = []
+    if wd:
+        chain.append(optax.add_decayed_weights(wd))
+    name = name.lower()
+    if name == "sgd":
+        chain.append(optax.sgd(lr, momentum=momentum if momentum else None))
+    elif name == "adam":
+        # reference uses amsgrad=True for client Adam (my_model_trainer.py:28-29)
+        chain.append(optax.amsgrad(lr))
+    elif name == "adamw":
+        chain.append(optax.adamw(lr))
+    elif name == "adagrad":
+        chain.append(optax.adagrad(lr))
+    elif name == "yogi":
+        chain.append(optax.yogi(lr))
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    return optax.chain(*chain)
+
+
+class LocalResult(NamedTuple):
+    variables: dict       # updated model variables (params [+ batch_stats])
+    train_loss: jax.Array  # mean loss over the last epoch
+    tau: jax.Array         # number of optimizer steps taken (FedNova)
+
+
+def make_local_train_fn(
+    bundle: ModelBundle,
+    task: Task,
+    *,
+    optimizer: str = "sgd",
+    lr: float = 0.01,
+    momentum: float = 0.0,
+    wd: float = 0.0,
+    epochs: int = 1,
+    batch_size: int = 32,
+    grad_clip: Optional[float] = None,
+    prox_mu: float = 0.0,
+    compute_dtype=None,
+) -> Callable[[dict, jax.Array, jax.Array, jax.Array, jax.Array], LocalResult]:
+    """Build ``local_train(variables, x, y, mask, rng) -> LocalResult``.
+
+    ``x/y/mask`` are one client's padded arrays [n_pad, ...]; n_pad must be a
+    multiple of batch_size (loaders guarantee this). Shapes are static, so
+    the function vmaps over a stacked client axis and shard_maps over a mesh.
+    """
+    tx = make_optimizer(optimizer, lr, momentum, wd)
+
+    def local_train(variables: dict, x, y, mask, rng) -> LocalResult:
+        n_pad = x.shape[0]
+        steps = n_pad // batch_size
+        params0 = variables["params"]
+        opt_state = tx.init(variables["params"])
+
+        if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            x_cast = x.astype(compute_dtype)
+        else:
+            x_cast = x
+
+        def epoch_fn(carry, ekey):
+            variables, opt_state = carry
+            perm = jax.random.permutation(ekey, n_pad)
+            xs = x_cast[perm].reshape((steps, batch_size) + x.shape[1:])
+            ys = y[perm].reshape((steps, batch_size) + y.shape[1:])
+            ms = mask[perm].reshape((steps, batch_size))
+            bkeys = jax.random.split(jax.random.fold_in(ekey, 0x5ba7), steps)
+
+            def step_fn(carry, batch):
+                variables, opt_state = carry
+                bx, by, bm, bkey = batch
+
+                def loss_fn(p):
+                    vars_in = dict(variables)
+                    vars_in["params"] = p
+                    logits, new_vars = bundle.apply_train(vars_in, bx, bkey)
+                    l = task.loss(logits, by, bm)
+                    if prox_mu:
+                        d = tree_sub(p, params0)
+                        l = l + 0.5 * prox_mu * tree_dot(d, d)
+                    return l, new_vars
+
+                (l, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    variables["params"]
+                )
+                if grad_clip:
+                    gnorm = optax.global_norm(grads)
+                    scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
+                    grads = jax.tree.map(lambda g: g * scale, grads)
+                updates, opt_state = tx.update(grads, opt_state, variables["params"])
+                params = optax.apply_updates(variables["params"], updates)
+                new_vars = dict(new_vars)
+                new_vars["params"] = params
+                return (new_vars, opt_state), l
+
+            (variables, opt_state), losses = jax.lax.scan(
+                step_fn, (variables, opt_state), (xs, ys, ms, bkeys)
+            )
+            return (variables, opt_state), jnp.mean(losses)
+
+        ekeys = jax.random.split(rng, epochs)
+        (variables, opt_state), ep_losses = jax.lax.scan(
+            epoch_fn, (variables, opt_state), ekeys
+        )
+        return LocalResult(variables, ep_losses[-1], jnp.asarray(epochs * steps, jnp.float32))
+
+    return local_train
+
+
+def make_eval_fn(bundle: ModelBundle, task: Task, eval_batch_size: int = 256):
+    """Build ``evaluate(variables, x, y, mask) -> dict of metric SUMS`` —
+    a scan over fixed-size batches, jitted once. Counterpart of the
+    reference's trainer.test (my_model_trainer.py:61-105) without the
+    per-batch host loop."""
+
+    @jax.jit
+    def evaluate(variables, x, y, mask):
+        n = x.shape[0]
+        steps = max(n // eval_batch_size, 1)
+        usable = steps * eval_batch_size
+        xs = x[:usable].reshape((steps, eval_batch_size) + x.shape[1:])
+        ys = y[:usable].reshape((steps, eval_batch_size) + y.shape[1:])
+        ms = mask[:usable].reshape((steps, eval_batch_size))
+
+        def body(acc, batch):
+            bx, by, bm = batch
+            logits = bundle.apply_eval(variables, bx)
+            m = task.metrics(logits, by, bm)
+            if acc is None:
+                return m, None
+            return jax.tree.map(jnp.add, acc, m), None
+
+        first = jax.tree.map(
+            jnp.zeros_like, task.metrics(bundle.apply_eval(variables, xs[0]), ys[0], ms[0])
+        )
+        acc, _ = jax.lax.scan(lambda a, b: body(a, b), first, (xs, ys, ms))
+        return acc
+
+    return evaluate
+
+
+def finalize_metrics(sums: dict) -> dict:
+    """Metric sums -> human metrics (acc, loss, precision/recall)."""
+    out = {}
+    count = float(sums.get("count", 1.0))
+    if "correct" in sums:
+        out["acc"] = float(sums["correct"]) / max(count, 1.0)
+    if "loss_sum" in sums:
+        out["loss"] = float(sums["loss_sum"]) / max(count, 1.0)
+    if "true_pos" in sums:
+        tp, fp, fn = (float(sums[k]) for k in ("true_pos", "false_pos", "false_neg"))
+        out["precision"] = tp / max(tp + fp, 1.0)
+        out["recall"] = tp / max(tp + fn, 1.0)
+    return out

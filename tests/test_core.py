@@ -189,3 +189,39 @@ class TestSerialization:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 code-review findings."""
+
+    def test_clip_norm_ignores_batch_stats(self):
+        g = {"params": {"w": jnp.zeros((4,))}, "batch_stats": {"running_mean": jnp.zeros((4,))}}
+        l = {"params": {"w": jnp.zeros((4,))}, "batch_stats": {"running_mean": 100.0 * jnp.ones((4,))}}
+        out = aggregation.clip_update_by_norm(g, l, clip=1.0)
+        # weight diff is 0, so stats must pass through unclipped
+        np.testing.assert_allclose(out["batch_stats"]["running_mean"], l["batch_stats"]["running_mean"])
+        np.testing.assert_allclose(out["params"]["w"], 0.0)
+
+    def test_dp_noise_skips_int_leaves(self):
+        p = {"w": jnp.ones((3,)), "num_batches_tracked": jnp.asarray(5, jnp.int32)}
+        out = aggregation.add_dp_noise(p, 0.5, jax.random.key(0))
+        assert int(out["num_batches_tracked"]) == 5
+        assert not np.allclose(out["w"], p["w"])
+
+    def test_weight_named_mean_is_still_clipped(self):
+        # precise fragments: a weight named 'mean_head' is a weight
+        g = {"params": {"mean_head": jnp.zeros((4,))}}
+        l = {"params": {"mean_head": 100.0 * jnp.ones((4,))}}
+        out = aggregation.clip_update_by_norm(g, l, clip=1.0)
+        assert float(tree_global_norm(out)) <= 1.0 + 1e-5
+
+    def test_partition_infeasible_floor_clamps(self):
+        labels = np.random.default_rng(0).integers(0, 3, size=90)
+        m = partition.non_iid_partition_with_dirichlet_distribution(
+            labels, 30, 3, alpha=0.5, seed=0, min_size_floor=10
+        )
+        assert sum(len(v) for v in m.values()) == 90
+
+    def test_serialization_rejects_int_keys(self):
+        with pytest.raises(TypeError):
+            serialization.tree_to_bytes({2: np.ones(2), 10: np.zeros(2)})
