@@ -1,0 +1,99 @@
+"""Flagship benchmark: FedAvg on CIFAR-10-shaped data with ResNet-56,
+32 non-IID clients (BASELINE.md north-star config), standalone-simulation
+paradigm on the available device (TPU when present).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: federated training throughput in images/sec through local SGD
+(the round is one jitted program: vmap over the sampled cohort of a
+lax.scan over minibatch SGD steps + weighted aggregation).
+
+vs_baseline: the reference publishes no throughput numbers (SURVEY.md §6),
+so the baseline constant is an estimate of the reference stack on its own
+headline hardware, 8xV100 (FedML paper, arXiv:2007.13518): 8 workers
+training ResNet-56/CIFAR-10 in parallel at ~1500 img/s/GPU fp32 = 12000
+img/s cluster-wide, ignoring its MPI state-dict exchange + 0.3 s/message
+poll overhead (com_manager.py:78) — i.e., a GENEROUS baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 12000.0  # 8xV100 estimate, see module docstring
+
+# Bench config (north star: 32 non-IID clients, ResNet-56, CIFAR-10 shapes)
+NUM_CLIENTS = 32
+CLIENTS_PER_ROUND = 8
+RECORDS_PER_CLIENT = 1562  # 50000/32
+BATCH_SIZE = 64
+EPOCHS = 1
+MEASURE_ROUNDS = 3
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.core.rng import sample_clients
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.models import create_model
+
+    # BENCH_SCALE=tiny: CI/CPU smoke of the same code path (not a benchmark).
+    tiny = os.environ.get("BENCH_SCALE") == "tiny"
+    model = os.environ.get("BENCH_MODEL", "resnet56")
+    records = 8 if tiny else RECORDS_PER_CLIENT
+    rounds = 1 if tiny else MEASURE_ROUNDS
+    batch = 8 if tiny else BATCH_SIZE
+    cohort = 2 if tiny else CLIENTS_PER_ROUND
+
+    ds = make_synthetic_classification(
+        "cifar10-bench", (32, 32, 3), 10, NUM_CLIENTS,
+        records_per_client=records,
+        partition_method="homo" if tiny else "hetero",
+        partition_alpha=0.5, batch_size=batch, seed=0,
+    )
+    cfg = FedConfig(
+        model=model, dataset="cifar10", client_num_in_total=NUM_CLIENTS,
+        client_num_per_round=cohort, comm_round=rounds,
+        batch_size=batch, epochs=EPOCHS, lr=0.1, momentum=0.9,
+        dtype="bfloat16", frequency_of_the_test=10_000, seed=0,
+    )
+    bundle = create_model(model, 10, dtype=jnp.bfloat16)
+    api = FedAvgAPI(ds, cfg, bundle)
+
+    # Warmup: compile the round program.
+    api.run_round(0)
+    jax.block_until_ready(api.variables)
+
+    t0 = time.perf_counter()
+    for r in range(1, rounds + 1):
+        api.run_round(r)
+    jax.block_until_ready(api.variables)
+    dt = time.perf_counter() - t0
+
+    # Images processed per measured period: cohort x padded records x epochs.
+    n_pad = ds.train_x.shape[1]
+    images = rounds * cohort * n_pad * EPOCHS
+    img_per_sec = images / dt
+    rounds_per_sec = rounds / dt
+
+    result = {
+        "metric": f"fedavg_local_sgd_images_per_sec ({model}, CIFAR-10 shapes, 32 non-IID clients, 8/round, bf16)",
+        "value": round(img_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "rounds_per_sec": round(rounds_per_sec, 4),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

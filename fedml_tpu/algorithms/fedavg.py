@@ -129,3 +129,54 @@ class FedAvgAPI:
         dt = time.time() - t0
         self.history["rounds_per_sec"] = c.comm_round / dt
         return self.history
+
+
+class CrossSiloFedAvgAPI(FedAvgAPI):
+    """Cross-silo distributed paradigm: clients sharded over a device mesh,
+    aggregation = weighted psum on ICI (replaces the reference's MPI
+    ServerManager/ClientManager star, SURVEY.md §3.2).
+
+    The sampled cohort size must be a multiple of the mesh size; each device
+    trains cohort/mesh_size clients per round under vmap.
+    """
+
+    def __init__(self, dataset, config, bundle=None, mesh=None):
+        from fedml_tpu.parallel.mesh import client_mesh
+
+        self.mesh = mesh or client_mesh()
+        super().__init__(dataset, config, bundle)
+        axis_sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        if "clients" not in axis_sizes:
+            raise ValueError(f"mesh must have a 'clients' axis, got {self.mesh.axis_names}")
+        n_clients_axis = axis_sizes["clients"]
+        # The EFFECTIVE cohort (run_round clamps to the dataset's client count)
+        # is what gets sharded — validate that, not the raw config value.
+        cohort = min(config.client_num_per_round, dataset.num_clients)
+        if cohort % n_clients_axis:
+            raise ValueError(
+                f"effective cohort size ({cohort}) must be a multiple of the "
+                f"mesh 'clients' axis ({n_clients_axis})"
+            )
+
+    def build_round_step(self):
+        from fedml_tpu.parallel.crosssilo import make_crosssilo_round, place_round_inputs
+
+        if type(self).aggregate is not FedAvgAPI.aggregate:
+            raise NotImplementedError(
+                f"{type(self).__name__} overrides aggregate(), which the in-mesh "
+                "psum path cannot honor; override build_round_step too, or pass a "
+                "server_update hook (applied after the psum), or use the "
+                "simulation paradigm (FedAvgAPI)."
+            )
+        round_fn = make_crosssilo_round(
+            self._local_train, self.mesh, server_update=self.server_update
+        )
+
+        def round_step(variables, cx, cy, cm, counts, rng):
+            keys = jax.random.split(rng, cx.shape[0])
+            variables, cx, cy, cm, counts, keys = place_round_inputs(
+                self.mesh, variables, cx, cy, cm, counts, keys
+            )
+            return round_fn(variables, cx, cy, cm, counts, keys)
+
+        return round_step

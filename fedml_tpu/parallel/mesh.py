@@ -1,0 +1,47 @@
+"""Mesh construction helpers.
+
+The reference maps MPI ranks to GPUs via gpu_mapping.yaml
+(fedml_api/distributed/utils/gpu_mapping.py:8-37) and IPs via csv. On TPU the
+"cluster map" is a `jax.sharding.Mesh`: federated clients shard along a
+'clients' axis; hierarchical FL uses a 2-D ('group', 'clients') mesh where the
+group axis is meant to ride DCN across pod slices and the client axis ICI
+(SURVEY.md §2.6.5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def client_mesh(n_devices: Optional[int] = None, axis: str = "clients") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.asarray(devs[:n]), (axis,))
+
+
+def hierarchical_mesh(num_groups: int, clients_per_group: int) -> Mesh:
+    devs = jax.devices()
+    need = num_groups * clients_per_group
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    arr = np.asarray(devs[:need]).reshape(num_groups, clients_per_group)
+    return Mesh(arr, ("group", "clients"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def client_sharded(mesh: Mesh, axis: str = "clients") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_client_batch(mesh: Mesh, arrays: Sequence, axis: str = "clients"):
+    """Place stacked per-client arrays with the client axis sharded over the
+    mesh and everything else replicated."""
+    sh = client_sharded(mesh, axis)
+    return tuple(jax.device_put(a, sh) for a in arrays)
