@@ -61,10 +61,11 @@ class CentralizedTrainer:
 
     def train(self) -> dict:
         history = {"round": [], "Test/Acc": [], "Test/Loss": []}
+        count = jnp.asarray(float(self.mask.sum()))
         for r in range(self.config.comm_round):
             res = self._train(
                 self.variables, jnp.asarray(self.x), jnp.asarray(self.y),
-                jnp.asarray(self.mask), round_key(self.root_key, r),
+                jnp.asarray(self.mask), count, round_key(self.root_key, r),
             )
             self.variables = res.variables
             if r % self.config.frequency_of_the_test == 0 or r == self.config.comm_round - 1:

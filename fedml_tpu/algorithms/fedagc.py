@@ -1,0 +1,30 @@
+"""FedAGC — adaptive-gradient-clipping aggregation (fork-specific algorithm).
+
+Counterpart of the fork's fedml_api/standalone/fedagc/silo_fedagc.py: each
+client's round update is clipped unit-wise relative to the global weights
+(NFNet-style AGC, silo_fedagc.py:12-29) before the weighted average
+(SiloFedAGC._aggregate :50-69). The clip math lives in
+fedml_tpu.core.aggregation.agc_clip_update.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.aggregation import agc_clip_update
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.parallel.local import LocalResult
+
+
+class FedAGCAPI(FedAvgAPI):
+    #: AGC clipping ratio lambda (fork default 1e-2)
+    clipping: float = 1e-2
+
+    def aggregate(self, variables, stacked_vars, counts, infos: LocalResult, rng, server_state):
+        clipped_params = jax.vmap(
+            lambda local: agc_clip_update(variables["params"], local, self.clipping)
+        )(stacked_vars["params"])
+        stacked = dict(stacked_vars)
+        stacked["params"] = clipped_params
+        return tree_weighted_mean(stacked, counts), server_state

@@ -59,6 +59,7 @@ class FedAvgAPI:
         self.variables = self.bundle.init(self.root_key)
         self._local_train = self.build_local_train()
         self._eval = make_eval_fn(self.bundle, self.task)
+        self.server_state = self.init_server_state()
         self._round_step = self.build_round_step()
         self.history: dict[str, list] = {"round": [], "Test/Acc": [], "Test/Loss": []}
 
@@ -73,23 +74,31 @@ class FedAvgAPI:
             compute_dtype=jnp.bfloat16 if c.dtype == "bfloat16" else None,
         )
 
-    def aggregate(self, variables, stacked_vars, counts, infos: LocalResult, rng):
-        """Weighted average (fedavg_api.py:100-115). Subclasses change this."""
-        return tree_weighted_mean(stacked_vars, counts)
+    def init_server_state(self):
+        """State threaded through aggregate() across rounds (FedOpt's server
+        optimizer moments, FedNova's momentum buffer, ...). {} = stateless."""
+        return {}
+
+    def aggregate(self, variables, stacked_vars, counts, infos: LocalResult, rng, server_state):
+        """Weighted average (fedavg_api.py:100-115). Subclasses change this.
+        Returns (new_variables, new_server_state); must be jit-pure."""
+        return tree_weighted_mean(stacked_vars, counts), server_state
 
     def build_round_step(self):
         local_train = self._local_train
         aggregate = self.aggregate
 
         @jax.jit
-        def round_step(variables, cx, cy, cm, counts, rng):
+        def round_step(variables, server_state, cx, cy, cm, counts, rng):
             keys = jax.random.split(rng, cx.shape[0])
-            res = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))(
-                variables, cx, cy, cm, keys
+            res = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+                variables, cx, cy, cm, counts, keys
             )
-            new_vars = aggregate(variables, res.variables, counts, res, rng)
+            new_vars, new_state = aggregate(
+                variables, res.variables, counts, res, rng, server_state
+            )
             train_loss = jnp.sum(res.train_loss * counts) / jnp.sum(counts)
-            return new_vars, train_loss
+            return new_vars, new_state, train_loss
 
         return round_step
 
@@ -104,8 +113,9 @@ class FedAvgAPI:
                                  seed=c.seed)
         cx, cy, cm, counts = self.dataset.client_slice(sampled)
         rk = round_key(self.root_key, round_idx)
-        self.variables, train_loss = self._round_step(
-            self.variables, cx, cy, cm, jnp.asarray(counts, jnp.float32), rk
+        self.variables, self.server_state, train_loss = self._round_step(
+            self.variables, self.server_state, cx, cy, cm,
+            jnp.asarray(counts, jnp.float32), rk
         )
         return float(train_loss)
 
@@ -172,11 +182,12 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
             self._local_train, self.mesh, server_update=self.server_update
         )
 
-        def round_step(variables, cx, cy, cm, counts, rng):
+        def round_step(variables, server_state, cx, cy, cm, counts, rng):
             keys = jax.random.split(rng, cx.shape[0])
             variables, cx, cy, cm, counts, keys = place_round_inputs(
                 self.mesh, variables, cx, cy, cm, counts, keys
             )
-            return round_fn(variables, cx, cy, cm, counts, keys)
+            new_vars, loss = round_fn(variables, cx, cy, cm, counts, keys)
+            return new_vars, server_state, loss
 
         return round_step

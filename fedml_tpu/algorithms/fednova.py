@@ -1,0 +1,60 @@
+"""FedNova — normalized averaging for heterogeneous local work
+(Wang et al. 2020).
+
+Counterpart of reference fedml_api/standalone/fednova/: a custom torch
+Optimizer accumulates a per-client normalizing coefficient a_i as it steps
+(fednova.py:10-155), and the trainer aggregates with an effective step count
+tau_eff (fednova_trainer.py:97-124). Here the same math is computed in closed
+form from the step count tau_i reported by the jitted local trainer
+(LocalResult.tau) — no custom optimizer needed:
+
+    a_i      = tau_i                                   (plain SGD)
+             = (tau_i - rho*(1-rho^tau_i)/(1-rho)) / (1-rho)   (momentum rho)
+    d_i      = (w_global - w_i) / a_i        normalized update direction
+    tau_eff  = sum_i p_i a_i                 p_i = n_i / n_total
+    w_next   = w_global - tau_eff * sum_i p_i d_i
+
+With homogeneous tau and no momentum this reduces exactly to FedAvg (the
+property the correctness test asserts).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.parallel.local import LocalResult
+
+
+class FedNovaAPI(FedAvgAPI):
+    def aggregate(self, variables, stacked_vars, counts, infos: LocalResult, rng, server_state):
+        rho = float(self.config.momentum)
+        tau = infos.tau.astype(jnp.float32)  # [C]
+        if rho > 0.0:
+            a = (tau - rho * (1.0 - jnp.power(rho, tau)) / (1.0 - rho)) / (1.0 - rho)
+        else:
+            a = tau
+        p = counts.astype(jnp.float32)
+        p = p / jnp.maximum(jnp.sum(p), 1e-12)
+        tau_eff = jnp.sum(p * a)
+
+        coef = (tau_eff * p / jnp.maximum(a, 1e-12))  # [C]
+
+        def combine(g, stacked_local):
+            # g - tau_eff * sum_i p_i (g - w_i)/a_i, computed leafwise
+            cb = coef.reshape((-1,) + (1,) * (stacked_local.ndim - 1))
+            delta = jnp.sum((g[None] - stacked_local.astype(jnp.float32)) * cb, axis=0)
+            return (g - delta).astype(stacked_local.dtype)
+
+        new_params = jax.tree.map(
+            lambda g, s: combine(g.astype(jnp.float32), s),
+            variables["params"], stacked_vars["params"],
+        )
+        # Non-param collections (BN stats): plain weighted average.
+        from fedml_tpu.core.pytree import tree_weighted_mean
+
+        new_vars = tree_weighted_mean(stacked_vars, counts)
+        new_vars = dict(new_vars)
+        new_vars["params"] = new_params
+        return new_vars, server_state

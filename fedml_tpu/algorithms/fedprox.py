@@ -1,0 +1,32 @@
+"""FedProx — local proximal regularization (Li et al. 2018).
+
+The reference ADVERTISES FedProx (fedml_api/distributed/fedprox/) but its
+trainer is byte-identical to FedAvg's — the proximal term was never
+implemented (verified in SURVEY.md §2.2: MyModelTrainer.py:18-48 is plain
+SGD/Adam). This implementation adds the real term: each local step minimizes
+
+    F_k(w) + (mu/2) ||w - w_global||^2
+
+which is exactly the ``prox_mu`` hook of the shared local trainer
+(fedml_tpu/parallel/local.py) — the gradient gains mu*(w - w_global).
+Aggregation is unchanged FedAvg.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.parallel.local import make_local_train_fn
+
+
+class FedProxAPI(FedAvgAPI):
+    def build_local_train(self):
+        c = self.config
+        return make_local_train_fn(
+            self.bundle, self.task,
+            optimizer=c.client_optimizer, lr=c.lr, momentum=c.momentum, wd=c.wd,
+            epochs=c.epochs, batch_size=c.batch_size, grad_clip=c.grad_clip,
+            prox_mu=c.fedprox_mu,
+            compute_dtype=jnp.bfloat16 if c.dtype == "bfloat16" else None,
+        )

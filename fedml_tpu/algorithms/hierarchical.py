@@ -1,0 +1,71 @@
+"""Hierarchical FL — two-tier client -> group -> global aggregation.
+
+Counterpart of reference fedml_api/standalone/hierarchical_fl/ (Group.train
+group.py:24-46, Trainer.train trainer.py:43-69; note the fork's import there
+is broken — SURVEY.md §2.2). Semantics: each global round runs
+``group_comm_round`` group rounds; within a group round every group trains
+its clients from the group model and aggregates within the group; after the
+group rounds, group models weighted-average into the global model.
+
+The equivalence property (reference CI asserts it, CI-script-fedavg.sh:51-57):
+with group_comm_round=1 the scheme equals flat FedAvg over all clients.
+
+TPU mapping: groups are segments of the client axis (segment_sum aggregation,
+fedml_tpu.core.aggregation.hierarchical_aggregate); on a 2-D
+('group','clients') mesh the group psum rides ICI and the global reduce DCN
+(SURVEY.md §2.6.5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.aggregation import hierarchical_aggregate
+from fedml_tpu.core.pytree import tree_index, tree_weighted_mean
+from fedml_tpu.core.rng import round_key
+
+
+class HierarchicalFedAvgAPI(FedAvgAPI):
+    """Standalone hierarchical simulator; clients assigned to groups
+    round-robin (client i -> group i % group_num, like the reference's even
+    split)."""
+
+    def __init__(self, dataset, config, bundle=None):
+        self.group_num = max(int(config.group_num), 1)
+        self.group_comm_round = max(int(config.group_comm_round), 1)
+        super().__init__(dataset, config, bundle)
+
+    def build_round_step(self):
+        local_train = self._local_train
+        group_num = self.group_num
+        group_rounds = self.group_comm_round
+
+        @jax.jit
+        def round_step(variables, server_state, cx, cy, cm, counts, rng):
+            C = cx.shape[0]
+            gids = jnp.arange(C) % group_num
+            # group model state: [G, ...] starting from the global model
+            group_vars = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (group_num,) + x.shape), variables
+            )
+
+            def one_group_round(group_vars, gr_key):
+                # every client trains from ITS group's current model
+                client_vars = jax.tree.map(lambda g: g[gids], group_vars)
+                keys = jax.random.split(gr_key, C)
+                res = jax.vmap(local_train)(client_vars, cx, cy, cm, counts, keys)
+                g_vars, _ = hierarchical_aggregate(res.variables, counts, gids, group_num)
+                return g_vars, jnp.sum(res.train_loss * counts) / jnp.sum(counts)
+
+            group_vars, losses = jax.lax.scan(
+                one_group_round, group_vars, jax.random.split(rng, group_rounds)
+            )
+            # global: weighted average of group models by group sample mass
+            gw = jax.ops.segment_sum(counts.astype(jnp.float32), gids, group_num)
+            new_vars = tree_weighted_mean(group_vars, gw)
+            return new_vars, server_state, losses[-1]
+
+        return round_step

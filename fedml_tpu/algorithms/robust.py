@@ -1,0 +1,85 @@
+"""FedAvg-robust — backdoor attack simulation + robust aggregation defenses.
+
+Counterpart of reference fedml_api/distributed/fedavg_robust/: client rank 1
+is a backdoor attacker training on poisoned data (FedAvgRobustTrainer.py:14-25,
+poisoned datasets from edge_case_examples/data_loader.py:283), the server
+defends with norm-difference clipping and weak-DP gaussian noise
+(FedAvgRobustAggregator.py:14-60 + robustness/robust_aggregation.py:38-55),
+and evaluation tracks the targeted backdoor success rate alongside main-task
+accuracy.
+
+Attack model here: pixel-trigger backdoor — the attacker stamps a trigger
+patch on its samples and relabels them to ``target_class``; backdoor success
+= fraction of triggered test inputs classified as the target.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.aggregation import robust_aggregate
+from fedml_tpu.parallel.local import LocalResult, finalize_metrics
+
+
+def stamp_trigger(x: np.ndarray, value: float = 2.5, size: int = 3) -> np.ndarray:
+    """Stamp a bright square in the top-left corner (image tensors [..., H, W, C]
+    or flat vectors — flat vectors get their first ``size*size`` features set)."""
+    x = np.array(x, copy=True)
+    if x.ndim >= 3:
+        x[..., :size, :size, :] = value
+    else:
+        x[..., : size * size] = value
+    return x
+
+
+class FedAvgRobustAPI(FedAvgAPI):
+    """FedAvg with one backdoor attacker and clip/DP server defenses."""
+
+    def __init__(self, dataset, config, bundle=None,
+                 attacker_idx: int = 0, target_class: int = 1,
+                 poison_frac: Optional[float] = None):
+        poison_frac = config.poison_frac if poison_frac is None else poison_frac
+        if poison_frac > 0:
+            dataset = self._poison(dataset, attacker_idx, target_class, poison_frac)
+        self.attacker_idx = attacker_idx
+        self.target_class = target_class
+        super().__init__(dataset, config, bundle)
+
+    @staticmethod
+    def _poison(dataset, attacker_idx: int, target_class: int, frac: float):
+        import dataclasses
+
+        tx = np.array(dataset.train_x, copy=True)
+        ty = np.array(dataset.train_y, copy=True)
+        # fraction of the attacker's REAL records (real rows come first in
+        # the padded layout), not of the padded length
+        n_real = int(dataset.train_mask[attacker_idx].sum())
+        n_poison = int(n_real * frac)
+        tx[attacker_idx, :n_poison] = stamp_trigger(tx[attacker_idx, :n_poison])
+        ty[attacker_idx, :n_poison] = target_class
+        return dataclasses.replace(dataset, train_x=tx, train_y=ty)
+
+    def aggregate(self, variables, stacked_vars, counts, infos: LocalResult, rng, server_state):
+        c = self.config
+        agg = robust_aggregate(
+            variables, stacked_vars, counts,
+            norm_bound=c.norm_bound, dp_stddev=c.stddev, rng=rng,
+        )
+        return agg, server_state
+
+    def evaluate_backdoor(self) -> dict:
+        """Targeted-class success on triggered test inputs (reference
+        FedAvgRobustAggregator's backdoor eval on the targeted task)."""
+        ds = self.dataset
+        keep = ds.test_y != self.target_class  # non-target samples only
+        x = stamp_trigger(np.asarray(ds.test_x)[keep])
+        y = np.full(x.shape[0], self.target_class, ds.test_y.dtype)
+        m = np.asarray(ds.test_mask)[keep]
+        # the jitted eval ceil-pads internally, no host-side padding needed
+        sums = self._eval(self.variables, x, y, m)
+        out = finalize_metrics(jax.tree.map(np.asarray, sums))
+        return {"backdoor_success": out.get("acc", 0.0)}

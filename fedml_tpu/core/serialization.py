@@ -82,6 +82,14 @@ def _pyify(x):
                     f"wire pytrees require string dict keys, got {type(k).__name__} {k!r}"
                 )
         return {"__d__": {k: _pyify(v) for k, v in x.items()}}
+    if isinstance(x, tuple) and hasattr(x, "_fields"):
+        # NamedTuples (optax optimizer states) keep their class identity so
+        # checkpoint resume restores the exact treedef tx.update expects.
+        cls = type(x)
+        return {
+            "__nt__": f"{cls.__module__}:{cls.__qualname__}",
+            "v": [_pyify(v) for v in x],
+        }
     if isinstance(x, tuple):
         return {"__t__": [_pyify(v) for v in x]}
     if isinstance(x, list):
@@ -97,6 +105,18 @@ def _unpyify(x):
     if isinstance(x, dict):
         if "__d__" in x:
             return {k: _unpyify(v) for k, v in x["__d__"].items()}
+        if "__nt__" in x:
+            vals = [_unpyify(v) for v in x["v"]]
+            mod, _, qual = x["__nt__"].partition(":")
+            try:
+                import importlib
+
+                cls = importlib.import_module(mod)
+                for part in qual.split("."):
+                    cls = getattr(cls, part)
+                return cls(*vals)
+            except (ImportError, AttributeError):
+                return tuple(vals)  # class gone: degrade to plain tuple
         if "__t__" in x:
             return tuple(_unpyify(v) for v in x["__t__"])
         if "__l__" in x:

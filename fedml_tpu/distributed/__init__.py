@@ -1,0 +1,7 @@
+"""Node runtimes, topologies, and edge federation (counterpart of
+fedml_core/distributed): the Message/Observer/handler-registry machinery kept
+for genuinely off-pod clients, plus graph topologies for decentralized FL.
+
+In-pod communication does NOT live here — it is XLA collectives
+(fedml_tpu.parallel.crosssilo); this package is the true network edge.
+"""

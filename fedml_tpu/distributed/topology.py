@@ -1,0 +1,96 @@
+"""Graph topologies for decentralized FL — weighted mixing matrices.
+
+Counterpart of reference fedml_core/distributed/topology/:
+- SymmetricTopologyManager (symmetric_topology_manager.py:21-52): ring with
+  ``neighbor_num`` undirected neighbors plus Watts-Strogatz random rewiring,
+  rows normalized to a doubly-stochastic-ish mixing matrix.
+- AsymmetricTopologyManager (asymmetric_topology_manager.py:23-74): directed
+  graph with distinct out/in degrees; row-normalized (out-weights).
+
+The matrix IS the communication pattern: one gossip round is
+``new_params = W @ stacked_params`` — a client-axis matmul XLA maps onto the
+MXU, or a sequence of ``ppermute`` rounds on a real ring (SURVEY.md §2.6.6).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BaseTopologyManager:
+    """Interface parity with the reference (base_topology_manager.py:4-24)."""
+
+    topology: np.ndarray
+
+    def generate_topology(self) -> None:
+        raise NotImplementedError
+
+    def get_in_neighbor_weights(self, node_index: int) -> np.ndarray:
+        return self.topology[:, node_index]
+
+    def get_out_neighbor_weights(self, node_index: int) -> np.ndarray:
+        return self.topology[node_index]
+
+    def get_in_neighbor_idx_list(self, node_index: int) -> list[int]:
+        col = self.topology[:, node_index]
+        return [i for i in range(len(col)) if col[i] > 0 and i != node_index]
+
+    def get_out_neighbor_idx_list(self, node_index: int) -> list[int]:
+        row = self.topology[node_index]
+        return [i for i in range(len(row)) if row[i] > 0 and i != node_index]
+
+
+class SymmetricTopologyManager(BaseTopologyManager):
+    """Undirected ring + random extra links, uniform row weights."""
+
+    def __init__(self, n: int, neighbor_num: int = 2, seed: int = 0):
+        self.n = n
+        self.neighbor_num = min(neighbor_num, max(n - 1, 1))
+        self.seed = seed
+        self.topology = np.zeros((n, n))
+
+    def generate_topology(self) -> None:
+        import networkx as nx
+
+        k = max(self.neighbor_num, 2) if self.n > 2 else 1
+        g = nx.connected_watts_strogatz_graph(self.n, min(k, self.n - 1) if self.n > 1 else 1,
+                                              p=0.3, seed=self.seed)
+        adj = nx.to_numpy_array(g) + np.eye(self.n)
+        adj = np.minimum(adj + adj.T, 1.0)  # symmetrize
+        self.topology = adj / adj.sum(axis=1, keepdims=True)
+
+    @property
+    def mixing_matrix(self) -> np.ndarray:
+        return self.topology
+
+
+class AsymmetricTopologyManager(BaseTopologyManager):
+    """Directed ring + random out-links; rows normalized (column sums vary —
+    the PushSum correction handles that)."""
+
+    def __init__(self, n: int, undirected_neighbor_num: int = 2,
+                 out_directed_neighbor: int = 2, seed: int = 0):
+        self.n = n
+        self.undirected_neighbor_num = undirected_neighbor_num
+        self.out_directed_neighbor = out_directed_neighbor
+        self.seed = seed
+        self.topology = np.zeros((n, n))
+
+    def generate_topology(self) -> None:
+        rng = np.random.default_rng(self.seed)
+        n = self.n
+        adj = np.eye(n)
+        for i in range(n):
+            # directed ring links
+            for d in range(1, self.undirected_neighbor_num + 1):
+                adj[i, (i + d) % n] = 1.0
+            # random extra out-links
+            extra = rng.choice(n, size=min(self.out_directed_neighbor, n - 1), replace=False)
+            for j in extra:
+                if j != i:
+                    adj[i, j] = 1.0
+        self.topology = adj / adj.sum(axis=1, keepdims=True)
+
+    @property
+    def mixing_matrix(self) -> np.ndarray:
+        return self.topology

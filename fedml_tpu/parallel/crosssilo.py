@@ -54,8 +54,8 @@ def make_crosssilo_round(
         variables = jax.tree.map(
             lambda x: jax.lax.pcast(x, axis_name=axis, to="varying"), variables
         )
-        res: LocalResult = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0))(
-            variables, cx, cy, cm, keys
+        res: LocalResult = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+            variables, cx, cy, cm, counts, keys
         )
         w = counts.astype(jnp.float32)
         total = jax.lax.psum(jnp.sum(w), axis)

@@ -77,19 +77,29 @@ def make_local_train_fn(
     prox_mu: float = 0.0,
     compute_dtype=None,
 ) -> Callable[[dict, jax.Array, jax.Array, jax.Array, jax.Array], LocalResult]:
-    """Build ``local_train(variables, x, y, mask, rng) -> LocalResult``.
+    """Build ``local_train(variables, x, y, mask, count, rng) -> LocalResult``.
 
     ``x/y/mask`` are one client's padded arrays [n_pad, ...]; n_pad must be a
-    multiple of batch_size (loaders guarantee this). Shapes are static, so
-    the function vmaps over a stacked client axis and shard_maps over a mesh.
+    multiple of batch_size (loaders guarantee this); ``count`` is the client's
+    REAL record count. Shapes are static, so the function vmaps over a
+    stacked client axis and shard_maps over a mesh.
+
+    Faithfulness to the reference's ragged execution under static shapes:
+    each epoch shuffles the REAL records to the front, and optimizer steps
+    beyond ceil(count/batch_size) are masked out (params and optimizer state
+    frozen), so a 10-sample client takes the same number of effective SGD
+    steps it would in the reference's Python loop — this is also what makes
+    the per-client tau in LocalResult honest for FedNova.
     """
     tx = make_optimizer(optimizer, lr, momentum, wd)
 
-    def local_train(variables: dict, x, y, mask, rng) -> LocalResult:
+    def local_train(variables: dict, x, y, mask, count, rng) -> LocalResult:
         n_pad = x.shape[0]
         steps = n_pad // batch_size
         params0 = variables["params"]
         opt_state = tx.init(variables["params"])
+        # effective steps/epoch for this client's real data (traced scalar)
+        steps_real = jnp.ceil(count.astype(jnp.float32) / batch_size).astype(jnp.int32)
 
         if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
             x_cast = x.astype(compute_dtype)
@@ -99,14 +109,19 @@ def make_local_train_fn(
         def epoch_fn(carry, ekey):
             variables, opt_state = carry
             perm = jax.random.permutation(ekey, n_pad)
-            xs = x_cast[perm].reshape((steps, batch_size) + x.shape[1:])
-            ys = y[perm].reshape((steps, batch_size) + y.shape[1:])
-            ms = mask[perm].reshape((steps, batch_size))
+            # stable-sort shuffled indices so real records come first: batches
+            # 0..steps_real-1 are the reference's real minibatches, later
+            # batches are pure padding and their steps get masked out.
+            order = perm[jnp.argsort(-mask[perm], stable=True)]
+            xs = x_cast[order].reshape((steps, batch_size) + x.shape[1:])
+            ys = y[order].reshape((steps, batch_size) + y.shape[1:])
+            ms = mask[order].reshape((steps, batch_size))
             bkeys = jax.random.split(jax.random.fold_in(ekey, 0x5ba7), steps)
 
             def step_fn(carry, batch):
                 variables, opt_state = carry
-                bx, by, bm, bkey = batch
+                bx, by, bm, bkey, step_idx = batch
+                live = (step_idx < steps_real).astype(jnp.float32)
 
                 def loss_fn(p):
                     vars_in = dict(variables)
@@ -125,22 +140,41 @@ def make_local_train_fn(
                     gnorm = optax.global_norm(grads)
                     scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
                     grads = jax.tree.map(lambda g: g * scale, grads)
-                updates, opt_state = tx.update(grads, opt_state, variables["params"])
+                updates, new_opt_state = tx.update(grads, opt_state, variables["params"])
                 params = optax.apply_updates(variables["params"], updates)
-                new_vars = dict(new_vars)
-                new_vars["params"] = params
-                return (new_vars, opt_state), l
+                # freeze params/opt/stats on dead (padding-only) steps
+                params = jax.tree.map(
+                    lambda new, old: live * new + (1.0 - live) * old
+                    if jnp.issubdtype(new.dtype, jnp.floating) else jnp.where(live > 0, new, old),
+                    params, variables["params"],
+                )
+                new_opt_state = jax.tree.map(
+                    lambda new, old: live * new + (1.0 - live) * old
+                    if jnp.issubdtype(new.dtype, jnp.floating) else jnp.where(live > 0, new, old),
+                    new_opt_state, opt_state,
+                )
+                out_vars = jax.tree.map(
+                    lambda new, old: live * new + (1.0 - live) * old
+                    if jnp.issubdtype(new.dtype, jnp.floating) else jnp.where(live > 0, new, old),
+                    new_vars, variables,
+                )
+                out_vars = dict(out_vars)
+                out_vars["params"] = params
+                return (out_vars, new_opt_state), l * live
 
             (variables, opt_state), losses = jax.lax.scan(
-                step_fn, (variables, opt_state), (xs, ys, ms, bkeys)
+                step_fn, (variables, opt_state),
+                (xs, ys, ms, bkeys, jnp.arange(steps)),
             )
-            return (variables, opt_state), jnp.mean(losses)
+            mean_loss = jnp.sum(losses) / jnp.maximum(steps_real.astype(jnp.float32), 1.0)
+            return (variables, opt_state), mean_loss
 
         ekeys = jax.random.split(rng, epochs)
         (variables, opt_state), ep_losses = jax.lax.scan(
             epoch_fn, (variables, opt_state), ekeys
         )
-        return LocalResult(variables, ep_losses[-1], jnp.asarray(epochs * steps, jnp.float32))
+        tau = (epochs * steps_real).astype(jnp.float32)
+        return LocalResult(variables, ep_losses[-1], tau)
 
     return local_train
 
@@ -154,11 +188,16 @@ def make_eval_fn(bundle: ModelBundle, task: Task, eval_batch_size: int = 256):
     @jax.jit
     def evaluate(variables, x, y, mask):
         n = x.shape[0]
-        steps = max(n // eval_batch_size, 1)
-        usable = steps * eval_batch_size
-        xs = x[:usable].reshape((steps, eval_batch_size) + x.shape[1:])
-        ys = y[:usable].reshape((steps, eval_batch_size) + y.shape[1:])
-        ms = mask[:usable].reshape((steps, eval_batch_size))
+        bs = min(eval_batch_size, n)
+        steps = -(-n // bs)  # ceil: pad the tail rather than dropping it
+        pad = steps * bs - n
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+            y = jnp.concatenate([y, jnp.zeros((pad,) + y.shape[1:], y.dtype)])
+            mask = jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)])
+        xs = x.reshape((steps, bs) + x.shape[1:])
+        ys = y.reshape((steps, bs) + y.shape[1:])
+        ms = mask.reshape((steps, bs))
 
         def body(acc, batch):
             bx, by, bm = batch

@@ -1,0 +1,3 @@
+"""Utilities: logging, metrics sinks (wandb-compatible), checkpointing,
+timing (counterpart of fedml_api/utils + the wandb plumbing the reference
+scatters through every main)."""

@@ -1,0 +1,51 @@
+"""Checkpoint/resume.
+
+The reference has NO general checkpoint mechanism (SURVEY.md §5.4): the silo
+fork duck-types ``save_model`` per validation round (silo_fedavg.py:82-92)
+and nothing can resume. Here any training state (variables + server state +
+round index + config) round-trips through one file, using the same
+self-describing pytree wire format as the edge transport.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Optional
+
+from fedml_tpu.core.serialization import tree_from_bytes, tree_to_bytes
+
+_MAGIC = b"FTCKPT1"
+
+
+def save_checkpoint(path: str, variables: Any, server_state: Any = None,
+                    round_idx: int = 0, extra: Optional[dict] = None) -> None:
+    meta = json.dumps({"round_idx": round_idx, "extra": extra or {}}).encode()
+    payload = tree_to_bytes({"variables": variables, "server_state": server_state or {}})
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(meta)))
+        f.write(meta)
+        f.write(payload)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+
+
+def load_checkpoint(path: str) -> dict:
+    with open(path, "rb") as f:
+        buf = f.read()
+    if buf[: len(_MAGIC)] != _MAGIC:
+        raise ValueError(f"{path} is not a fedml_tpu checkpoint")
+    off = len(_MAGIC)
+    (mlen,) = struct.unpack("<Q", buf[off : off + 8])
+    off += 8
+    meta = json.loads(buf[off : off + mlen].decode())
+    tree = tree_from_bytes(buf[off + mlen :])
+    return {
+        "variables": tree["variables"],
+        "server_state": tree["server_state"],
+        "round_idx": meta["round_idx"],
+        "extra": meta["extra"],
+    }

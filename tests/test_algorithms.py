@@ -1,0 +1,222 @@
+"""Algorithm-zoo correctness tests, built on exact-math properties:
+
+- FedOpt(server sgd, lr=1) == FedAvg (pseudo-grad step of 1 recovers the avg)
+- FedProx(mu=0) == FedAvg; mu>0 shrinks the update toward the global model
+- FedNova == FedAvg under homogeneous tau and plain SGD
+- FedAGC == FedAvg when clipping never binds
+- Robust aggregation bounds the attacker's influence; backdoor eval works
+- Hierarchical(group_comm_round=1) == flat FedAvg (reference CI property,
+  CI-script-fedavg.sh:51-57)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.fedagc import FedAGCAPI
+from fedml_tpu.algorithms.fednova import FedNovaAPI
+from fedml_tpu.algorithms.fedopt import FedOptAPI
+from fedml_tpu.algorithms.fedprox import FedProxAPI
+from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
+from fedml_tpu.algorithms.robust import FedAvgRobustAPI, stamp_trigger
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.pytree import tree_global_norm, tree_sub
+from fedml_tpu.data.synthetic import make_synthetic_classification
+from fedml_tpu.models import create_model
+
+
+def _ds(clients=6, dim=8, classes=3, seed=0):
+    return make_synthetic_classification(
+        "algo", (dim,), classes, clients, records_per_client=12,
+        partition_method="homo", batch_size=6, seed=seed,
+    )
+
+
+def _cfg(ds, **kw):
+    base = dict(
+        model="lr", client_num_in_total=ds.num_clients,
+        client_num_per_round=ds.num_clients, comm_round=3, epochs=1,
+        batch_size=6, lr=0.2, seed=11, frequency_of_the_test=100,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _bundle(ds):
+    return create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:])
+
+
+def _rel_diff(a, b):
+    d = float(tree_global_norm(tree_sub(a.variables["params"], b.variables["params"])))
+    s = float(tree_global_norm(b.variables["params"]))
+    return d / max(s, 1e-9)
+
+
+class TestFedOpt:
+    def test_server_sgd_lr1_equals_fedavg(self):
+        ds = _ds()
+        avg = FedAvgAPI(ds, _cfg(ds), _bundle(ds)); avg.train()
+        opt = FedOptAPI(ds, _cfg(ds, server_optimizer="sgd", server_lr=1.0), _bundle(ds)); opt.train()
+        assert _rel_diff(opt, avg) < 1e-6
+
+    def test_server_momentum_state_persists(self):
+        ds = _ds()
+        api = FedOptAPI(ds, _cfg(ds, server_optimizer="sgd", server_lr=1.0,
+                                 server_momentum=0.9), _bundle(ds))
+        api.train()
+        trace = api.server_state["opt"][0].trace
+        assert float(tree_global_norm(trace)) > 0  # momentum buffer accumulated
+
+    def test_fedadam_runs(self):
+        ds = _ds()
+        api = FedOptAPI(ds, _cfg(ds, server_optimizer="adam", server_lr=0.01), _bundle(ds))
+        hist = api.train()
+        assert np.isfinite(hist["Test/Loss"][-1])
+
+
+class TestFedProx:
+    def test_mu_zero_equals_fedavg(self):
+        ds = _ds()
+        avg = FedAvgAPI(ds, _cfg(ds), _bundle(ds)); avg.train()
+        prox = FedProxAPI(ds, _cfg(ds, fedprox_mu=0.0), _bundle(ds)); prox.train()
+        assert _rel_diff(prox, avg) < 1e-6
+
+    def test_large_mu_pins_to_global(self):
+        ds = _ds()
+        cfg = _cfg(ds, comm_round=1)
+        avg = FedAvgAPI(ds, cfg, _bundle(ds))
+        w0 = jax.tree.map(jnp.copy, avg.variables["params"])
+        avg.train()
+        # lr*mu must stay < 1 for stability; mu=2, lr=0.2 contracts toward w0
+        prox = FedProxAPI(ds, _cfg(ds, comm_round=1, fedprox_mu=2.0), _bundle(ds))
+        prox.train()
+        move_avg = float(tree_global_norm(tree_sub(avg.variables["params"], w0)))
+        move_prox = float(tree_global_norm(tree_sub(prox.variables["params"], w0)))
+        assert move_prox < move_avg
+
+
+class TestFedNova:
+    def test_homogeneous_tau_equals_fedavg(self):
+        ds = _ds()
+        avg = FedAvgAPI(ds, _cfg(ds), _bundle(ds)); avg.train()
+        nova = FedNovaAPI(ds, _cfg(ds), _bundle(ds)); nova.train()
+        assert _rel_diff(nova, avg) < 1e-5
+
+    def test_heterogeneous_sizes_run(self):
+        # hetero partition -> unequal client sizes -> unequal padded batches
+        ds = make_synthetic_classification(
+            "nova", (8,), 3, 6, records_per_client=20,
+            partition_method="hetero", partition_alpha=0.3, batch_size=4, seed=2,
+        )
+        api = FedNovaAPI(ds, _cfg(ds, batch_size=4), _bundle(ds))
+        hist = api.train()
+        assert np.isfinite(hist["Test/Loss"][-1])
+
+
+class TestFedAGC:
+    def test_loose_clip_equals_fedavg(self):
+        ds = _ds()
+        avg = FedAvgAPI(ds, _cfg(ds), _bundle(ds)); avg.train()
+        agc = FedAGCAPI(ds, _cfg(ds), _bundle(ds))
+        agc.clipping = 1e6  # never binds
+        agc._round_step = agc.build_round_step()
+        agc.train()
+        assert _rel_diff(agc, avg) < 1e-6
+
+    def test_tight_clip_shrinks_update(self):
+        ds = _ds()
+        avg = FedAvgAPI(ds, _cfg(ds, comm_round=1), _bundle(ds))
+        w0 = jax.tree.map(jnp.copy, avg.variables["params"])
+        avg.train()
+        agc = FedAGCAPI(ds, _cfg(ds, comm_round=1), _bundle(ds))
+        agc.clipping = 1e-4
+        agc._round_step = agc.build_round_step()
+        agc.train()
+        move_avg = float(tree_global_norm(tree_sub(avg.variables["params"], w0)))
+        move_agc = float(tree_global_norm(tree_sub(agc.variables["params"], w0)))
+        assert move_agc < move_avg
+
+
+class TestRobust:
+    def test_norm_bound_limits_attacker(self):
+        ds = _ds(clients=4)
+        cfg = _cfg(ds, comm_round=1, norm_bound=0.05, lr=1.0)
+        api = FedAvgRobustAPI(ds, cfg, _bundle(ds), poison_frac=0.5)
+        w0 = jax.tree.map(jnp.copy, api.variables["params"])
+        api.train()
+        move = float(tree_global_norm(tree_sub(api.variables["params"], w0)))
+        assert move <= 0.05 + 1e-4  # every client clipped to <= bound
+
+    def test_backdoor_eval_runs(self):
+        ds = _ds(clients=4)
+        api = FedAvgRobustAPI(ds, _cfg(ds, comm_round=1), _bundle(ds), poison_frac=0.5)
+        api.train()
+        out = api.evaluate_backdoor()
+        assert 0.0 <= out["backdoor_success"] <= 1.0
+
+    def test_stamp_trigger_images_and_vectors(self):
+        img = np.zeros((2, 8, 8, 3)); vec = np.zeros((2, 30))
+        assert stamp_trigger(img)[0, 0, 0, 0] == 2.5
+        assert stamp_trigger(vec)[0, 0] == 2.5
+        assert img[0, 0, 0, 0] == 0.0  # no mutation
+
+
+class TestHierarchical:
+    def test_one_group_round_equals_flat(self):
+        # full batch so per-round RNG (batch order) can't differ between paths
+        ds = _ds(clients=6)
+        n_pad = ds.train_x.shape[1]
+        flat = FedAvgAPI(ds, _cfg(ds, batch_size=n_pad), _bundle(ds)); flat.train()
+        hier = HierarchicalFedAvgAPI(
+            ds, _cfg(ds, batch_size=n_pad, group_num=3, group_comm_round=1), _bundle(ds)
+        )
+        hier.train()
+        assert _rel_diff(hier, flat) < 1e-5
+
+    def test_multiple_group_rounds_run(self):
+        ds = _ds(clients=6)
+        hier = HierarchicalFedAvgAPI(
+            ds, _cfg(ds, group_num=2, group_comm_round=3), _bundle(ds)
+        )
+        hist = hier.train()
+        assert np.isfinite(hist["Test/Loss"][-1])
+
+
+class TestReviewRegressions:
+    def test_fednova_differs_from_fedavg_under_hetero_tau(self):
+        # unequal real counts -> unequal tau -> normalized avg != plain avg
+        ds = make_synthetic_classification(
+            "novah", (8,), 3, 4, records_per_client=24,
+            partition_method="hetero", partition_alpha=0.2, batch_size=4, seed=5,
+        )
+        counts = ds.train_counts
+        assert counts.max() > counts.min()  # genuinely heterogeneous
+        cfg = _cfg(ds, batch_size=4, comm_round=1)
+        avg = FedAvgAPI(ds, cfg, _bundle(ds)); avg.train()
+        nova = FedNovaAPI(ds, cfg, _bundle(ds)); nova.train()
+        assert _rel_diff(nova, avg) > 1e-6
+
+    def test_local_step_count_respects_real_records(self):
+        # a 4-record client at batch 4 must take exactly 1 step/epoch even
+        # though the padded shape allows more
+        from fedml_tpu.core.tasks import get_task
+        from fedml_tpu.parallel.local import make_local_train_fn
+        import jax
+
+        ds = make_synthetic_classification(
+            "tau", (8,), 3, 2, records_per_client=4,
+            partition_method="homo", batch_size=4, seed=0,
+        )
+        bundle = _bundle(ds)
+        lt = make_local_train_fn(bundle, get_task("classification"),
+                                 optimizer="sgd", lr=0.1, epochs=2, batch_size=4)
+        v = bundle.init(jax.random.key(0))
+        cx, cy, cm, counts = ds.client_slice(np.array([0]))
+        res = jax.vmap(lt, in_axes=(None, 0, 0, 0, 0, 0))(
+            v, jnp.asarray(cx), jnp.asarray(cy), jnp.asarray(cm),
+            jnp.asarray(counts, jnp.float32), jax.random.split(jax.random.key(1), 1),
+        )
+        expected = 2 * int(np.ceil(counts[0] / 4))
+        assert int(res.tau[0]) == expected

@@ -64,7 +64,7 @@ class TestConvergence:
         ds = make_synthetic_lr(1.0, 1.0, num_clients=20, dim=30, classes=5, batch_size=10, seed=1)
         cfg = FedConfig(
             model="lr", client_num_in_total=20, client_num_per_round=10,
-            comm_round=30, epochs=3, batch_size=10, lr=0.3,
+            comm_round=40, epochs=4, batch_size=10, lr=0.3,
             frequency_of_the_test=10, seed=1,
         )
         api = FedAvgAPI(ds, cfg, create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:]))
