@@ -1,0 +1,173 @@
+"""SplitNN — split learning with a client/server layer cut.
+
+Reference: fedml_api/distributed/split_nn/ — the model's lower layers live
+on each client, the upper layers on the server; per minibatch the client
+sends activations forward and receives activation-gradients back
+(client.py:24-34, server.py:40-60); clients take turns in a ring via a
+semaphore token (client_manager.py:29-52), the server rotates
+``active_node`` per epoch (server.py:70).
+
+TPU-native redesign (SURVEY.md §7 hard part (c)): in-datacenter the stage
+boundary is NOT a wire — client forward, server forward/backward, and both
+optimizer updates are ONE fused jitted program per minibatch batch-scan, so
+the per-batch round trip that dominates the reference (SURVEY.md §3.3 "hot
+loop = per-batch round trip!") costs nothing. The relay ring (client k
+trains an epoch, token passes to k+1) is preserved as the ALGORITHM —
+sequential by design, it's what makes SplitNN SplitNN. The message-driven
+variant for genuinely remote clients lives in
+fedml_tpu/distributed/split_nn_edge.py with the same per-batch protocol as
+the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.rng import round_key, seed_everything
+from fedml_tpu.core.tasks import get_task
+from fedml_tpu.data import FedDataset
+from fedml_tpu.models import ModelBundle
+from fedml_tpu.parallel.local import make_optimizer
+
+log = logging.getLogger(__name__)
+
+
+def make_splitnn_epoch_fn(
+    client_bundle: ModelBundle,
+    server_bundle: ModelBundle,
+    task,
+    tx_client: optax.GradientTransformation,
+    tx_server: optax.GradientTransformation,
+    batch_size: int,
+):
+    """Build ``epoch(cvars, svars, c_opt, s_opt, x, y, mask, count, rng)`` —
+    one client-epoch of fused two-stage SGD as a single jitted scan.
+
+    The reference's per-batch exchange (acts fwd / grads bwd over MPI,
+    SURVEY.md §3.3) becomes a single jax.grad through both stages: XLA sees
+    client-fwd -> server-fwd -> loss -> server-bwd -> client-bwd as one
+    graph and fuses the boundary away.
+    """
+
+    @jax.jit
+    def epoch(cvars, svars, c_opt, s_opt, x, y, mask, count, rng):
+        n_pad = x.shape[0]
+        steps = n_pad // batch_size
+        steps_real = jnp.ceil(count.astype(jnp.float32) / batch_size).astype(jnp.int32)
+        perm = jax.random.permutation(rng, n_pad)
+        order = perm[jnp.argsort(-mask[perm], stable=True)]
+        xs = x[order].reshape((steps, batch_size) + x.shape[1:])
+        ys = y[order].reshape((steps, batch_size) + y.shape[1:])
+        ms = mask[order].reshape((steps, batch_size))
+
+        def step(carry, batch):
+            cvars, svars, c_opt, s_opt = carry
+            bx, by, bm, idx = batch
+            live = (idx < steps_real).astype(jnp.float32)
+
+            def loss_fn(cparams, sparams):
+                acts = client_bundle.module.apply({**cvars, "params": cparams}, bx, train=True)
+                logits = server_bundle.module.apply({**svars, "params": sparams}, acts, train=True)
+                return task.loss(logits, by, bm)
+
+            loss, (gc, gs) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                cvars["params"], svars["params"]
+            )
+
+            def apply(tx, grads, opt, params):
+                updates, new_opt = tx.update(grads, opt, params)
+                new_params = optax.apply_updates(params, updates)
+                freeze = lambda n, o: live * n + (1.0 - live) * o
+                return jax.tree.map(freeze, new_params, params), jax.tree.map(freeze, new_opt, opt)
+
+            cparams, c_opt = apply(tx_client, gc, c_opt, cvars["params"])
+            sparams, s_opt = apply(tx_server, gs, s_opt, svars["params"])
+            return ({**cvars, "params": cparams}, {**svars, "params": sparams}, c_opt, s_opt), loss * live
+
+        (cvars, svars, c_opt, s_opt), losses = jax.lax.scan(
+            step, (cvars, svars, c_opt, s_opt), (xs, ys, ms, jnp.arange(steps))
+        )
+        mean_loss = jnp.sum(losses) / jnp.maximum(steps_real.astype(jnp.float32), 1.0)
+        return cvars, svars, c_opt, s_opt, mean_loss
+
+    return epoch
+
+
+class SplitNNAPI:
+    """Relay-ring split learning (reference SplitNNAPI.py:15-39).
+
+    Per the reference protocol each client holds ITS OWN lower-stage weights
+    (they are never aggregated — only the server stage accumulates across
+    clients) and trains ``epochs`` epochs when it holds the token.
+    """
+
+    def __init__(
+        self,
+        dataset: FedDataset,
+        config: FedConfig,
+        client_bundle: ModelBundle,
+        server_bundle: ModelBundle,
+    ):
+        self.dataset = dataset
+        self.config = config
+        self.client_bundle = client_bundle
+        self.server_bundle = server_bundle
+        self.task = get_task(dataset.task)
+        self.root_key = seed_everything(config.seed)
+
+        # reference optimizers: SGD lr .1 momentum .9 wd 5e-4 for BOTH stages
+        # (split_nn/client.py:18-19, server.py:19-20); ours come from config.
+        self.tx_client = make_optimizer(config.client_optimizer, config.lr, config.momentum, config.wd)
+        self.tx_server = make_optimizer(config.client_optimizer, config.lr, config.momentum, config.wd)
+
+        n_clients = dataset.num_clients
+        keys = jax.random.split(self.root_key, n_clients + 1)
+        self.client_vars = [self.client_bundle.init(keys[i]) for i in range(n_clients)]
+        self.server_vars = self.server_bundle.init(keys[-1])
+        self.client_opts = [self.tx_client.init(v["params"]) for v in self.client_vars]
+        self.server_opt = self.tx_server.init(self.server_vars["params"])
+
+        self._epoch = make_splitnn_epoch_fn(
+            client_bundle, server_bundle, self.task,
+            self.tx_client, self.tx_server, config.batch_size,
+        )
+        self.history: dict[str, list] = {"epoch_loss": [], "val_acc": []}
+
+    def _eval_client(self, k: int) -> float:
+        """Server-side validation through client k's stage on the global test
+        pool (reference validates whenever a client finishes its turn,
+        server.py:62-70)."""
+        x, y, m = self.dataset.test_x, self.dataset.test_y, self.dataset.test_mask
+        acts = self.client_bundle.apply_eval(self.client_vars[k], x)
+        logits = self.server_bundle.apply_eval(self.server_vars, acts)
+        metrics = self.task.metrics(logits, y, m)
+        return float(metrics["correct"]) / max(float(metrics["count"]), 1.0)
+
+    def train(self) -> dict:
+        c = self.config
+        n_clients = self.dataset.num_clients
+        for r in range(c.comm_round):
+            rk = round_key(self.root_key, r)
+            # relay ring: client 0 -> 1 -> ... -> n-1 (semaphore protocol,
+            # client_manager.py:29-52), each training its local epochs
+            for k in range(n_clients):
+                x, y, m, count = self.dataset.client_slice(np.asarray([k]))
+                cv, co = self.client_vars[k], self.client_opts[k]
+                for e in range(c.epochs):
+                    ekey = jax.random.fold_in(jax.random.fold_in(rk, k), e)
+                    cv, self.server_vars, co, self.server_opt, loss = self._epoch(
+                        cv, self.server_vars, co, self.server_opt,
+                        x[0], y[0], m[0], jnp.float32(count[0]), ekey,
+                    )
+                self.client_vars[k], self.client_opts[k] = cv, co
+                self.history["epoch_loss"].append(float(loss))
+            self.history["val_acc"].append(self._eval_client(n_clients - 1))
+            log.info("splitnn round %d val_acc %.4f", r, self.history["val_acc"][-1])
+        return self.history

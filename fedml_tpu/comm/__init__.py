@@ -1,0 +1,57 @@
+"""Edge-transport communication layer (reference L0/L1).
+
+In-mesh federation (simulation / cross-silo on one pod) never touches this
+package — aggregation is a weighted ``psum`` over the device mesh
+(fedml_tpu/parallel/crosssilo.py). This package exists for *genuinely
+external* participants: off-pod silos, mobile clients, cross-datacenter
+federation — the role the reference's MPI/gRPC/MQTT backends play
+(fedml_core/distributed/communication/, SURVEY.md §2.7).
+
+Surface mirrors the reference: ``Message`` envelope (message.py:5-74),
+``Observer`` callback (observer.py:4-7), ``BaseCommunicationManager``
+(base_com_manager.py:7-27), concrete backends selected by name via
+``create_comm_manager``. Differences by design:
+
+- payloads are flat-buffer pytrees (core/serialization.py), not pickled
+  torch state_dicts or JSON nested lists;
+- the local backend uses blocking queues, not the reference MPI backend's
+  0.3 s receive poll (com_manager.py:78) or ctypes thread kill
+  (mpi_send_thread.py:47-53);
+- gRPC uses a generic bytes RPC (no generated stubs to drift out of sync
+  with a .proto).
+"""
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.local import LocalCommunicationManager, LocalRouter
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+
+
+def create_comm_manager(backend: str, **kwargs):
+    """Backend factory (reference client_manager.py:20-32 backend switch)."""
+    if backend in ("LOCAL", "local", "MPI"):
+        # MPI's role (single-datacenter multi-process ranks) is played by the
+        # in-process router for simulation and by jax.distributed + mesh
+        # collectives for real multi-host — there is no mpi4py path.
+        return LocalCommunicationManager(**kwargs)
+    if backend in ("GRPC", "grpc"):
+        from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+        return GRPCCommManager(**kwargs)
+    if backend in ("MQTT", "mqtt"):
+        from fedml_tpu.comm.mqtt_backend import MqttCommManager
+
+        return MqttCommManager(**kwargs)
+    raise ValueError(f"unknown comm backend: {backend!r}")
+
+
+__all__ = [
+    "Message",
+    "Observer",
+    "BaseCommunicationManager",
+    "LocalCommunicationManager",
+    "LocalRouter",
+    "ClientManager",
+    "ServerManager",
+    "create_comm_manager",
+]

@@ -1,0 +1,48 @@
+"""Abstract comm-manager + observer interfaces.
+
+Reference: fedml_core/distributed/communication/base_com_manager.py:7-27 and
+observer.py:4-7. The surface is kept so algorithm managers written against
+the reference port over directly; semantics differ in one way: backends here
+deliver messages via blocking queues (no polling latency) and support
+graceful shutdown (no MPI.COMM_WORLD.Abort()).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List
+
+from fedml_tpu.comm.message import Message
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type, msg_params: Message) -> None:
+        ...
+
+
+class BaseCommunicationManager(abc.ABC):
+    def __init__(self) -> None:
+        self._observers: List[Observer] = []
+
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Block, dispatching incoming messages to observers, until stopped."""
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None:
+        ...
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)

@@ -1,0 +1,108 @@
+"""In-process multi-rank transport.
+
+Plays the role of the reference MPI backend
+(fedml_core/distributed/communication/mpi/) for single-host runs and tests:
+N logical ranks exchanging Messages. The reference implementation uses two
+daemon threads + two queues per process with a 0.3 s receive poll
+(com_manager.py:71-79) and kills threads via
+ctypes PyThreadState_SetAsyncExc (mpi_send_thread.py:47-53) — both
+explicitly NOT replicated (SURVEY.md §5.2): here delivery is a single
+blocking ``queue.Queue`` per rank and shutdown is a sentinel message.
+
+Real multi-host TPU runs don't use this either — they use jax.distributed +
+mesh collectives (fedml_tpu/parallel/). This backend exists so the
+message-driven algorithm managers (SplitNN, FedGKT, base_framework, edge
+federation) can run all ranks in one process, each rank on its own thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.message import Message
+
+_STOP = object()
+
+
+class LocalRouter:
+    """Shared mailbox set for a group of ranks (one per launch)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._queues: Dict[int, "queue.Queue"] = {r: queue.Queue() for r in range(size)}
+
+    def post(self, rank: int, item) -> None:
+        self._queues[int(rank)].put(item)
+
+    def take(self, rank: int, timeout: Optional[float] = None):
+        return self._queues[int(rank)].get(timeout=timeout)
+
+
+class LocalCommunicationManager(BaseCommunicationManager):
+    def __init__(self, router: LocalRouter, rank: int, wire_roundtrip: bool = False):
+        super().__init__()
+        self.router = router
+        self.rank = int(rank)
+        self._running = False
+        # When set, every message is serialized+deserialized in transit —
+        # tests use this to exercise the exact bytes a gRPC hop would carry.
+        self.wire_roundtrip = wire_roundtrip
+
+    def send_message(self, msg: Message) -> None:
+        payload = Message.from_bytes(msg.to_bytes()) if self.wire_roundtrip else msg
+        self.router.post(msg.get_receiver_id(), payload)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            item = self.router.take(self.rank)
+            if item is _STOP:
+                break
+            self._notify(item)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.router.post(self.rank, _STOP)
+
+
+def run_ranks(make_manager, size: int, wire_roundtrip: bool = False, timeout: float = 300.0):
+    """Launch ``size`` ranks on threads; rank r runs make_manager(r, comm).
+
+    ``make_manager`` returns an object with ``.run()`` (typically a
+    ClientManager/ServerManager subclass). Returns the per-rank manager
+    objects after all threads join. Mirrors the reference's
+    ``mpirun -np N`` + rank branch (FedAvgAPI.py:20-28) for in-process use.
+    """
+    router = LocalRouter(size)
+    managers = []
+    for r in range(size):
+        comm = LocalCommunicationManager(router, r, wire_roundtrip=wire_roundtrip)
+        managers.append(make_manager(r, comm))
+
+    errors: Dict[int, BaseException] = {}
+
+    def _run(rank: int, m) -> None:
+        try:
+            m.run()
+        except BaseException as e:  # propagate to the caller, unblock peers
+            errors[rank] = e
+            for peer in range(size):
+                router.post(peer, _STOP)
+
+    threads = [
+        threading.Thread(target=_run, args=(r, m), daemon=True, name=f"rank{r}")
+        for r, m in enumerate(managers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+        if t.is_alive() and not errors:
+            raise TimeoutError(f"rank thread {t.name} did not finish within {timeout}s")
+    if errors:
+        rank, err = sorted(errors.items())[0]
+        raise RuntimeError(f"rank {rank} raised during run_ranks") from err
+    return managers

@@ -1,0 +1,69 @@
+"""Node runtime: handler-registry managers (reference L2).
+
+Reference: fedml_core/distributed/client/client_manager.py:13-73 and
+server/server_manager.py:13-68 — both are Observers; ``run()`` registers
+message handlers then blocks in ``com_manager.handle_receive_message()``;
+dispatch is ``message_handler_dict[msg_type]`` (client_manager.py:43-47).
+
+Kept: the exact registry/run/dispatch surface, so every message-driven
+algorithm (SplitNN, FedGKT, edge FedAvg…) is a thin subclass, as in the
+reference. Changed: ``finish()`` performs a graceful stop of the receive
+loop instead of ``MPI.COMM_WORLD.Abort()`` (client_manager.py:66-69) — a
+hard abort with no drain, flagged in SURVEY.md §5.3 as a defect.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import Message
+
+LOG = logging.getLogger(__name__)
+
+
+class _ManagerBase(Observer):
+    def __init__(self, args, comm: BaseCommunicationManager, rank: int = 0, size: int = 0):
+        self.args = args
+        self.com_manager = comm
+        self.rank = int(rank)
+        self.size = int(size)
+        self.com_manager.add_observer(self)
+        self.message_handler_dict: Dict[object, Callable[[Message], None]] = {}
+
+    def register_comm_manager(self, comm: BaseCommunicationManager) -> None:
+        self.com_manager = comm
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.com_manager.handle_receive_message()
+        LOG.debug("rank %d run loop exited", self.rank)
+
+    def register_message_receive_handlers(self) -> None:
+        raise NotImplementedError
+
+    def register_message_receive_handler(self, msg_type, handler: Callable[[Message], None]) -> None:
+        self.message_handler_dict[msg_type] = handler
+
+    def receive_message(self, msg_type, msg_params: Message) -> None:
+        handler = self.message_handler_dict.get(msg_type)
+        if handler is None:
+            LOG.warning("rank %d: no handler for msg_type=%r", self.rank, msg_type)
+            return
+        handler(msg_params)
+
+    def send_message(self, message: Message) -> None:
+        self.com_manager.send_message(message)
+
+    def finish(self) -> None:
+        """Graceful drain-and-stop (NOT the reference's COMM_WORLD.Abort)."""
+        self.com_manager.stop_receive_message()
+
+
+class ClientManager(_ManagerBase):
+    """Per-client runtime (reference client/client_manager.py:13-73)."""
+
+
+class ServerManager(_ManagerBase):
+    """Rank-0 runtime (reference server/server_manager.py:13-68)."""

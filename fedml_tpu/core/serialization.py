@@ -26,6 +26,29 @@ Pytree = Any
 _MAGIC = b"FTPU1"
 
 
+def frame_pack(magic: bytes, header: Any, *payloads: bytes) -> bytes:
+    """The one binary framing used everywhere a JSON header fronts raw
+    buffers (pytree wire format here, comm/message.py envelopes,
+    utils/checkpoint.py files): MAGIC | u64 header_len | JSON | payloads."""
+    hbytes = json.dumps(header).encode("utf-8")
+    return b"".join([magic, struct.pack("<Q", len(hbytes)), hbytes, *payloads])
+
+
+def frame_unpack(magic: bytes, buf: bytes) -> tuple[Any, int]:
+    """Returns (header, payload_offset); raises on a foreign or torn buffer."""
+    if buf[: len(magic)] != magic:
+        raise ValueError(f"bad magic: expected {magic!r}")
+    off = len(magic)
+    if len(buf) < off + 8:
+        raise ValueError("truncated frame: missing header length")
+    (hlen,) = struct.unpack("<Q", buf[off : off + 8])
+    off += 8
+    if len(buf) < off + hlen:
+        raise ValueError("truncated frame: incomplete header")
+    header = json.loads(buf[off : off + hlen].decode("utf-8"))
+    return header, off + hlen
+
+
 def tree_to_bytes(tree: Pytree) -> bytes:
     """Serialize an arbitrary pytree of arrays to a self-describing buffer."""
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
@@ -37,19 +60,11 @@ def tree_to_bytes(tree: Pytree) -> bytes:
         "shapes": [list(x.shape) for x in leaves],
         "dtypes": [x.dtype.str for x in leaves],
     }
-    hbytes = json.dumps(header).encode("utf-8")
-    chunks = [_MAGIC, struct.pack("<Q", len(hbytes)), hbytes]
-    for x in leaves:
-        chunks.append(np.ascontiguousarray(x).tobytes())
-    return b"".join(chunks)
+    return frame_pack(_MAGIC, header, *[np.ascontiguousarray(x).tobytes() for x in leaves])
 
 
 def tree_from_bytes(buf: bytes) -> Pytree:
-    if buf[:5] != _MAGIC:
-        raise ValueError("bad magic: not a fedml_tpu pytree buffer")
-    (hlen,) = struct.unpack("<Q", buf[5:13])
-    header = json.loads(buf[13 : 13 + hlen].decode("utf-8"))
-    off = 13 + hlen
+    header, off = frame_unpack(_MAGIC, buf)
     leaves = []
     for shape, dtype in zip(header["shapes"], header["dtypes"]):
         dt = np.dtype(dtype)

@@ -5,3 +5,15 @@ for genuinely off-pod clients, plus graph topologies for decentralized FL.
 In-pod communication does NOT live here — it is XLA collectives
 (fedml_tpu.parallel.crosssilo); this package is the true network edge.
 """
+
+from fedml_tpu.distributed.topology import (
+    AsymmetricTopologyManager,
+    BaseTopologyManager,
+    SymmetricTopologyManager,
+)
+
+__all__ = [
+    "BaseTopologyManager",
+    "SymmetricTopologyManager",
+    "AsymmetricTopologyManager",
+]

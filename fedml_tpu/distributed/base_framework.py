@@ -1,0 +1,110 @@
+"""Minimal distributed-algorithm template (reference distributed/base_framework).
+
+Reference: fedml_api/distributed/base_framework/algorithm_api.py:16-38 — the
+smallest possible message-driven algorithm: the server broadcasts an init
+signal, each client computes a numeric "local result", the server averages
+and broadcasts the global result, for ``comm_round`` rounds. Exists as the
+template every message-driven algorithm copies, and as the transport smoke
+test (CI-script-framework.sh:16-24 launches exactly this).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+import numpy as np
+
+from fedml_tpu.comm import ClientManager, Message, ServerManager
+from fedml_tpu.comm.local import run_ranks
+
+LOG = logging.getLogger(__name__)
+
+MSG_TYPE_S2C_INIT = 1
+MSG_TYPE_C2S_RESULT = 2
+MSG_TYPE_S2C_SYNC = 3
+MSG_TYPE_S2C_FINISH = 4
+
+MSG_ARG_KEY_RESULT = "local_result"
+MSG_ARG_KEY_GLOBAL = "global_result"
+
+
+class BaseServerManager(ServerManager):
+    def __init__(self, args, comm, rank, size):
+        super().__init__(args, comm, rank, size)
+        self.round_idx = 0
+        self.comm_round = int(getattr(args, "comm_round", 1))
+        self.results: dict[int, float] = {}
+        self.global_history: List[float] = []
+
+    def run(self):
+        self.register_message_receive_handlers()
+        for client in range(1, self.size):
+            self.send_message(Message(MSG_TYPE_S2C_INIT, self.rank, client))
+        self.com_manager.handle_receive_message()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_C2S_RESULT, self.handle_result)
+
+    def handle_result(self, msg: Message):
+        self.results[msg.get_sender_id()] = float(msg.get(MSG_ARG_KEY_RESULT))
+        if len(self.results) == self.size - 1:  # barrier by message counting
+            global_result = float(np.mean(list(self.results.values())))
+            self.global_history.append(global_result)
+            self.results.clear()
+            self.round_idx += 1
+            done = self.round_idx >= self.comm_round
+            for client in range(1, self.size):
+                m = Message(MSG_TYPE_S2C_FINISH if done else MSG_TYPE_S2C_SYNC, self.rank, client)
+                m.add_params(MSG_ARG_KEY_GLOBAL, global_result)
+                self.send_message(m)
+            if done:
+                self.finish()
+
+
+class BaseClientManager(ClientManager):
+    def __init__(self, args, comm, rank, size, local_fn=None):
+        super().__init__(args, comm, rank, size)
+        # local "training": any callable (round_idx, global_result) -> float
+        self.local_fn = local_fn or (lambda r, g: float(self.rank) + (g or 0.0))
+        self.round_idx = 0
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_S2C_INIT, self.handle_init)
+        self.register_message_receive_handler(MSG_TYPE_S2C_SYNC, self.handle_sync)
+        self.register_message_receive_handler(MSG_TYPE_S2C_FINISH, self.handle_finish)
+
+    def _train_and_send(self, global_result):
+        result = self.local_fn(self.round_idx, global_result)
+        m = Message(MSG_TYPE_C2S_RESULT, self.rank, 0)
+        m.add_params(MSG_ARG_KEY_RESULT, float(result))
+        self.send_message(m)
+        self.round_idx += 1
+
+    def handle_init(self, msg: Message):
+        self._train_and_send(None)
+
+    def handle_sync(self, msg: Message):
+        self._train_and_send(msg.get(MSG_ARG_KEY_GLOBAL))
+
+    def handle_finish(self, msg: Message):
+        self.finish()
+
+
+def run_base_framework(client_num: int, comm_round: int = 3, wire_roundtrip: bool = True):
+    """In-process launch of server + clients (reference's `mpirun -np N`)."""
+
+    class Args:
+        pass
+
+    args = Args()
+    args.comm_round = comm_round
+    size = client_num + 1
+
+    def make(rank, comm):
+        if rank == 0:
+            return BaseServerManager(args, comm, rank, size)
+        return BaseClientManager(args, comm, rank, size)
+
+    managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip)
+    return managers[0].global_history

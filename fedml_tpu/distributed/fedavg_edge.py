@@ -1,0 +1,275 @@
+"""Message-driven FedAvg for edge/off-pod federation (reference distributed/fedavg).
+
+Reference: fedml_api/distributed/fedavg/ — FedAvgServerManager.py:18-95,
+FedAvgClientManager.py:18-75, FedAVGAggregator.py:13-163, message_define.py:
+1-30. One process per participant, star topology, model weights in messages.
+
+The TPU framework uses this paradigm ONLY at the true network edge (silos
+behind gRPC, mobile clients); in-datacenter runs use the mesh-collective
+path (parallel/crosssilo.py) which needs no messages at all. Per-worker
+compute is the same jitted local-train scan used everywhere else — a worker
+simulates `client_num_in_total / workers` logical clients by dataset
+re-binding, exactly like the reference's client-sampling concurrency model
+(FedAvgClientManager.handle_message_receive_model_from_server:50-61).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import numpy as np
+
+from fedml_tpu.comm import ClientManager, Message, ServerManager
+from fedml_tpu.comm.local import run_ranks
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_CLIENT_INDEX,
+    MSG_ARG_KEY_MODEL_PARAMS,
+    MSG_ARG_KEY_NUM_SAMPLES,
+)
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.rng import round_key, sample_clients
+from fedml_tpu.core.tasks import get_task
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel.local import finalize_metrics, make_eval_fn, make_local_train_fn
+
+LOG = logging.getLogger(__name__)
+
+# message_define.py:1-30
+MSG_TYPE_S2C_INIT_CONFIG = 1
+MSG_TYPE_S2C_SYNC_MODEL = 2
+MSG_TYPE_C2S_SEND_MODEL = 3
+MSG_TYPE_S2C_FINISH = 4
+
+
+class FedAVGAggregator:
+    """Server-side state: collect worker results, weighted-average, sample.
+
+    Reference FedAVGAggregator.py:13-163. add_local_trained_result /
+    check_whether_all_receive / aggregate keep their names; aggregation math
+    is the shared tree_weighted_mean primitive.
+    """
+
+    def __init__(self, variables, worker_num: int, config, dataset=None, bundle=None):
+        self.variables = variables
+        self.worker_num = worker_num
+        self.config = config
+        self.dataset = dataset
+        self.model_dict: dict[int, dict] = {}
+        self.sample_num_dict: dict[int, float] = {}
+        self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}
+        self.test_history: list[dict] = []
+        self._eval = make_eval_fn(bundle, get_task(dataset.task)) if bundle is not None and dataset is not None else None
+
+    def get_global_model_params(self):
+        return self.variables
+
+    def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
+        self.model_dict[index] = model_params
+        self.sample_num_dict[index] = float(sample_num)
+        self.flag_client_model_uploaded_dict[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        if not all(self.flag_client_model_uploaded_dict.values()):
+            return False
+        for i in self.flag_client_model_uploaded_dict:
+            self.flag_client_model_uploaded_dict[i] = False
+        return True
+
+    def aggregate(self):
+        order = sorted(self.model_dict)
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *[self.model_dict[i] for i in order])
+        counts = np.asarray([self.sample_num_dict[i] for i in order], np.float32)
+        self.variables = tree_weighted_mean(stacked, counts)
+        self.model_dict.clear()
+        return self.variables
+
+    def client_sampling(self, round_idx: int, client_num_in_total: int, client_num_per_round: int):
+        return sample_clients(round_idx, client_num_in_total, client_num_per_round, seed=self.config.seed)
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> Optional[dict]:
+        if self._eval is None:
+            return None
+        sums = self._eval(self.variables, self.dataset.test_x, self.dataset.test_y, self.dataset.test_mask)
+        m = finalize_metrics(jax.tree.map(np.asarray, sums))
+        m["round"] = round_idx
+        self.test_history.append(m)
+        return m
+
+
+class FedAvgEdgeServerManager(ServerManager):
+    """Reference FedAvgServerManager.py:18-95."""
+
+    def __init__(self, args, comm, rank, size, aggregator: FedAVGAggregator):
+        super().__init__(args, comm, rank, size)
+        self.aggregator = aggregator
+        self.round_num = int(args.comm_round)
+        self.round_idx = 0
+
+    def run(self):
+        self.register_message_receive_handlers()
+        self.send_init_msg()
+        self.com_manager.handle_receive_message()
+
+    def _assignments(self, round_idx: int) -> list[list[int]]:
+        """Sample client_num_per_round logical clients and deal them to the
+        size-1 workers round-robin — the reference's worker/logical-client
+        re-binding (FedAvgClientManager.py:50-61) generalized to
+        cohort != worker_num."""
+        cohort = min(self.args.client_num_per_round, self.args.client_num_in_total)
+        sampled = self.aggregator.client_sampling(
+            round_idx, self.args.client_num_in_total, cohort
+        )
+        workers = self.size - 1
+        return [[int(c) for c in sampled[w::workers]] for w in range(workers)]
+
+    def send_init_msg(self):
+        assignments = self._assignments(0)
+        global_params = self.aggregator.get_global_model_params()
+        for rank in range(1, self.size):
+            m = Message(MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
+            m.add_params(MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            m.add_params(MSG_ARG_KEY_CLIENT_INDEX, assignments[rank - 1])
+            self.send_message(m)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_SEND_MODEL, self.handle_message_receive_model_from_client
+        )
+
+    def handle_message_receive_model_from_client(self, msg: Message):
+        sender = msg.get_sender_id()
+        self.aggregator.add_local_trained_result(
+            sender - 1, msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_NUM_SAMPLES)
+        )
+        if not self.aggregator.check_whether_all_receive():
+            return
+        global_params = self.aggregator.aggregate()
+        if (
+            self.round_idx % self.args.frequency_of_the_test == 0
+            or self.round_idx == self.round_num - 1
+        ):
+            self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            for rank in range(1, self.size):
+                self.send_message(Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
+            self.finish()
+            return
+        assignments = self._assignments(self.round_idx)
+        for rank in range(1, self.size):
+            m = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, rank)
+            m.add_params(MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            m.add_params(MSG_ARG_KEY_CLIENT_INDEX, assignments[rank - 1])
+            self.send_message(m)
+
+
+class FedAVGTrainer:
+    """Worker-side trainer wrapper (reference FedAVGTrainer.py:4-52): holds
+    the jitted local-train fn and re-binds the logical client's data slice."""
+
+    def __init__(self, dataset, bundle, config):
+        self.dataset = dataset
+        self.config = config
+        self.local_train = jax.jit(
+            make_local_train_fn(
+                bundle, get_task(dataset.task),
+                optimizer=config.client_optimizer, lr=config.lr,
+                momentum=config.momentum, wd=config.wd,
+                epochs=config.epochs, batch_size=config.batch_size,
+                grad_clip=config.grad_clip,
+            )
+        )
+        self.client_indices: list[int] = []
+
+    def update_dataset(self, client_indices) -> None:
+        self.client_indices = [int(c) for c in client_indices]
+
+    def train(self, variables, round_idx: int, root_key):
+        """Train each assigned logical client from the same global weights and
+        return the sample-weighted mean of the results + total count — the
+        partial aggregate, so the server's weighted mean over workers equals
+        the weighted mean over all sampled clients exactly."""
+        if not self.client_indices:
+            return jax.tree.map(np.asarray, variables), 0.0
+        trees, counts = [], []
+        for ci in self.client_indices:
+            x, y, m, count = self.dataset.client_slice(np.asarray([ci]))
+            rng = jax.random.fold_in(round_key(root_key, round_idx), ci)
+            res = self.local_train(variables, x[0], y[0], m[0], np.float32(count[0]), rng)
+            trees.append(res.variables)
+            counts.append(float(count[0]))
+        from fedml_tpu.core.pytree import tree_weighted_sum_list
+
+        mean = jax.tree.map(np.asarray, tree_weighted_sum_list(trees, counts))
+        return mean, float(sum(counts))
+
+
+class FedAvgEdgeClientManager(ClientManager):
+    """Reference FedAvgClientManager.py:18-75."""
+
+    def __init__(self, args, comm, rank, size, trainer: FedAVGTrainer, root_key):
+        super().__init__(args, comm, rank, size)
+        self.trainer = trainer
+        self.root_key = root_key
+        self.round_idx = 0
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_SYNC_MODEL, self.handle_message_receive_model_from_server
+        )
+        self.register_message_receive_handler(MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+
+    def handle_message_init(self, msg: Message):
+        self.round_idx = 0
+        self._train_and_send(msg)
+
+    def handle_message_receive_model_from_server(self, msg: Message):
+        self.round_idx += 1
+        self._train_and_send(msg)
+
+    def handle_message_finish(self, msg: Message):
+        self.finish()
+
+    def _train_and_send(self, msg: Message):
+        self.trainer.update_dataset(msg.get(MSG_ARG_KEY_CLIENT_INDEX))
+        variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        new_vars, n = self.trainer.train(variables, self.round_idx, self.root_key)
+        out = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+        out.add_params(MSG_ARG_KEY_MODEL_PARAMS, new_vars)
+        out.add_params(MSG_ARG_KEY_NUM_SAMPLES, n)
+        self.send_message(out)
+
+
+def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = True):
+    """In-process launch: 1 server + worker_num clients over the local
+    transport (the reference's mpirun path, FedAvgAPI.py:20-28). Returns the
+    server's aggregator (holding the final global model + test history)."""
+    from fedml_tpu.core.rng import seed_everything
+
+    bundle = create_model(config.model, dataset.class_num, input_shape=dataset.train_x.shape[2:] or None)
+    root_key = seed_everything(config.seed)
+    variables0 = bundle.init(root_key)
+    size = worker_num + 1
+
+    class Args:
+        pass
+
+    args = Args()
+    args.comm_round = config.comm_round
+    args.client_num_in_total = min(config.client_num_in_total, dataset.num_clients)
+    args.client_num_per_round = min(config.client_num_per_round, args.client_num_in_total)
+    args.frequency_of_the_test = config.frequency_of_the_test
+
+    aggregator = FedAVGAggregator(variables0, worker_num, config, dataset=dataset, bundle=bundle)
+
+    def make(rank, comm):
+        if rank == 0:
+            return FedAvgEdgeServerManager(args, comm, rank, size, aggregator)
+        trainer = FedAVGTrainer(dataset, bundle, config)
+        return FedAvgEdgeClientManager(args, comm, rank, size, trainer, root_key)
+
+    run_ranks(make, size, wire_roundtrip=wire_roundtrip)
+    return aggregator

@@ -52,8 +52,13 @@ class SymmetricTopologyManager(BaseTopologyManager):
     def generate_topology(self) -> None:
         import networkx as nx
 
-        k = max(self.neighbor_num, 2) if self.n > 2 else 1
-        g = nx.connected_watts_strogatz_graph(self.n, min(k, self.n - 1) if self.n > 1 else 1,
+        if self.n <= 2:
+            # watts_strogatz needs k>=2 edges per node; for 1-2 nodes the
+            # only sensible mixing matrix is plain averaging
+            self.topology = np.full((self.n, self.n), 1.0 / self.n)
+            return
+        k = max(self.neighbor_num, 2)
+        g = nx.connected_watts_strogatz_graph(self.n, min(k, self.n - 1),
                                               p=0.3, seed=self.seed)
         adj = nx.to_numpy_array(g) + np.eye(self.n)
         adj = np.minimum(adj + adj.T, 1.0)  # symmetrize

@@ -1,0 +1,95 @@
+"""Split-model pairs for SplitNN (reference fedml_api/distributed/split_nn).
+
+The reference splits an arbitrary torch model into client-side lower layers
+and server-side upper layers, with activations crossing the process
+boundary (split_nn/client.py:24-34, server.py:40-60). Here a split pair is
+two ModelBundles — the client bundle maps input -> cut activations, the
+server bundle maps activations -> logits — so both halves stay pure jit
+functions and the in-mesh trainer can fuse them into ONE program (the
+boundary only materializes for genuinely off-pod clients).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle
+
+
+class _ClientCNN(nn.Module):
+    """Lower half: two conv blocks -> flattened feature activations."""
+
+    features: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.features * 2, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x.reshape((x.shape[0], -1))
+
+
+class _ServerMLP(nn.Module):
+    """Upper half: dense head on the cut activations."""
+
+    hidden: int = 128
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.output_dim)(x)
+
+
+def create_split_cnn(
+    output_dim: int,
+    input_shape: Sequence[int] = (28, 28, 1),
+    features: int = 32,
+    hidden: int = 128,
+) -> tuple[ModelBundle, ModelBundle]:
+    """(client_bundle, server_bundle) for a CNN split at the flatten point."""
+    input_shape = tuple(input_shape)
+    h, w = input_shape[0] // 4, input_shape[1] // 4
+    act_dim = h * w * features * 2
+    client = ModelBundle(
+        name="splitnn_client_cnn",
+        module=_ClientCNN(features=features),
+        input_shape=input_shape,
+    )
+    server = ModelBundle(
+        name="splitnn_server_mlp",
+        module=_ServerMLP(hidden=hidden, output_dim=output_dim),
+        input_shape=(act_dim,),
+    )
+    return client, server
+
+
+def create_split_mlp(
+    output_dim: int,
+    input_shape: Sequence[int],
+    cut_dim: int = 64,
+) -> tuple[ModelBundle, ModelBundle]:
+    """Dense/dense split for flat-feature datasets (synthetic, tabular)."""
+    input_shape = tuple(input_shape)
+
+    class _ClientDense(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(cut_dim)(x)
+            return nn.relu(x)
+
+    client = ModelBundle(name="splitnn_client_mlp", module=_ClientDense(), input_shape=input_shape)
+    server = ModelBundle(
+        name="splitnn_server_mlp",
+        module=_ServerMLP(hidden=cut_dim, output_dim=output_dim),
+        input_shape=(cut_dim,),
+    )
+    return client, server

@@ -142,24 +142,21 @@ def make_local_train_fn(
                     grads = jax.tree.map(lambda g: g * scale, grads)
                 updates, new_opt_state = tx.update(grads, opt_state, variables["params"])
                 params = optax.apply_updates(variables["params"], updates)
+
                 # freeze params/opt/stats on dead (padding-only) steps
-                params = jax.tree.map(
-                    lambda new, old: live * new + (1.0 - live) * old
-                    if jnp.issubdtype(new.dtype, jnp.floating) else jnp.where(live > 0, new, old),
-                    params, variables["params"],
-                )
-                new_opt_state = jax.tree.map(
-                    lambda new, old: live * new + (1.0 - live) * old
-                    if jnp.issubdtype(new.dtype, jnp.floating) else jnp.where(live > 0, new, old),
-                    new_opt_state, opt_state,
-                )
-                out_vars = jax.tree.map(
-                    lambda new, old: live * new + (1.0 - live) * old
-                    if jnp.issubdtype(new.dtype, jnp.floating) else jnp.where(live > 0, new, old),
-                    new_vars, variables,
-                )
-                out_vars = dict(out_vars)
-                out_vars["params"] = params
+                def freeze_if_dead(new, old):
+                    return jax.tree.map(
+                        lambda n, o: live * n + (1.0 - live) * o
+                        if jnp.issubdtype(n.dtype, jnp.floating) else jnp.where(live > 0, n, o),
+                        new, old,
+                    )
+
+                new_opt_state = freeze_if_dead(new_opt_state, opt_state)
+                out_vars = dict(freeze_if_dead(
+                    {k: v for k, v in new_vars.items() if k != "params"},
+                    {k: v for k, v in variables.items() if k != "params"},
+                ))
+                out_vars["params"] = freeze_if_dead(params, variables["params"])
                 return (out_vars, new_opt_state), l * live
 
             (variables, opt_state), losses = jax.lax.scan(

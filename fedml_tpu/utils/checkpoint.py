@@ -9,40 +9,35 @@ self-describing pytree wire format as the edge transport.
 
 from __future__ import annotations
 
-import json
 import os
-import struct
 from typing import Any, Optional
 
-from fedml_tpu.core.serialization import tree_from_bytes, tree_to_bytes
+from fedml_tpu.core.serialization import (
+    frame_pack,
+    frame_unpack,
+    tree_from_bytes,
+    tree_to_bytes,
+)
 
 _MAGIC = b"FTCKPT1"
 
 
 def save_checkpoint(path: str, variables: Any, server_state: Any = None,
                     round_idx: int = 0, extra: Optional[dict] = None) -> None:
-    meta = json.dumps({"round_idx": round_idx, "extra": extra or {}}).encode()
     payload = tree_to_bytes({"variables": variables, "server_state": server_state or {}})
+    buf = frame_pack(_MAGIC, {"round_idx": round_idx, "extra": extra or {}}, payload)
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(tmp, "wb") as f:
-        f.write(_MAGIC)
-        f.write(struct.pack("<Q", len(meta)))
-        f.write(meta)
-        f.write(payload)
+        f.write(buf)
     os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
 
 
 def load_checkpoint(path: str) -> dict:
     with open(path, "rb") as f:
         buf = f.read()
-    if buf[: len(_MAGIC)] != _MAGIC:
-        raise ValueError(f"{path} is not a fedml_tpu checkpoint")
-    off = len(_MAGIC)
-    (mlen,) = struct.unpack("<Q", buf[off : off + 8])
-    off += 8
-    meta = json.loads(buf[off : off + mlen].decode())
-    tree = tree_from_bytes(buf[off + mlen :])
+    meta, off = frame_unpack(_MAGIC, buf)
+    tree = tree_from_bytes(buf[off:])
     return {
         "variables": tree["variables"],
         "server_state": tree["server_state"],

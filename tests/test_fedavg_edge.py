@@ -1,0 +1,35 @@
+"""Edge (message-driven) FedAvg must match the reference protocol semantics:
+rounds advance by message counting, aggregation is sample-weighted, and the
+final model is a legitimate FedAvg result (loss decreases, eval history
+recorded). Counterpart of the reference's distributed CI runs over real MPI
+(run_fedavg_distributed_pytorch.sh) executed in-process."""
+
+import numpy as np
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data import load_dataset
+from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+
+
+def test_fedavg_edge_runs_and_improves():
+    cfg = FedConfig(
+        model="lr",
+        dataset="synthetic_1_1",
+        client_num_in_total=8,
+        client_num_per_round=4,
+        comm_round=6,
+        batch_size=10,
+        lr=0.1,
+        epochs=2,
+        frequency_of_the_test=1,
+        seed=3,
+    )
+    ds = load_dataset("synthetic_1_1", num_clients=8, batch_size=10, seed=3)
+    agg = run_fedavg_edge(ds, cfg, worker_num=4, wire_roundtrip=True)
+    hist = agg.test_history
+    assert len(hist) == 6  # eval every round
+    assert hist[-1]["round"] == 5
+    # training over the wire must actually learn (tiny non-IID task is noisy
+    # round-to-round, so compare the best round against round 0)
+    assert min(h["loss"] for h in hist[1:]) < hist[0]["loss"]
+    assert max(h["acc"] for h in hist[1:]) > max(0.25, hist[0]["acc"])
