@@ -68,6 +68,7 @@ class FedConfig:
     alpha_distill: float = 1.0
     model_client: str = "resnet8"
     model_server: str = "resnet56_server"
+    epochs_server: int = 1           # reference --epochs_server / epoch strategy
 
     # runtime / backend
     backend: str = "mesh"            # mesh | inproc | grpc | mqtt (reference: MPI|GRPC|MQTT)
@@ -153,6 +154,10 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--norm_bound", type=float, default=None)
     p.add_argument("--stddev", type=float, default=None)
     p.add_argument("--temperature", type=float, default=defaults.temperature)
+    p.add_argument("--alpha_distill", type=float, default=defaults.alpha_distill)
+    p.add_argument("--model_client", type=str, default=defaults.model_client)
+    p.add_argument("--model_server", type=str, default=defaults.model_server)
+    p.add_argument("--epochs_server", type=int, default=defaults.epochs_server)
     p.add_argument("--backend", type=str, default=defaults.backend)
     p.add_argument("--frequency_of_the_test", type=int, default=defaults.frequency_of_the_test)
     p.add_argument("--is_mobile", type=int, default=defaults.is_mobile)

@@ -1,0 +1,126 @@
+"""Group-Knowledge-Transfer split ResNet pair (client edge net + server net).
+
+Counterpart of reference fedml_api/model/cv/resnet56_gkt/{resnet_client.py,
+resnet_server.py}: the client runs a small ResNet-8-style net that returns
+BOTH its auxiliary logits and the extracted feature map
+(resnet_client.py:189-203 returns ``logits, extracted_features``); the server
+runs the remaining ResNet-56-style stages taking that feature map as input
+(resnet_server.py:185+).
+
+TPU design: both halves are flax modules over NHWC feature maps; the client
+half is small enough to ``vmap`` a whole cohort of per-client models on one
+chip, and the server half trains on the union of all clients' features as one
+large dense batch — the MXU-friendly re-expression of the reference's
+DataParallel server loop (GKTServerTrainer.py:28-29).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.resnet import BasicBlock
+
+
+class GKTClientNet(nn.Module):
+    """Edge net: stem + `blocks` 16-filter BasicBlocks; returns
+    (aux_logits, feature_map[B,32,32,16])."""
+
+    blocks: int = 3
+    output_dim: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(x))
+        for _ in range(self.blocks):
+            x = BasicBlock(16, 1, dtype=self.dtype)(x, train=train)
+        features = x
+        pooled = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.output_dim, dtype=jnp.float32)(pooled.astype(jnp.float32))
+        return logits, features
+
+
+class GKTServerNet(nn.Module):
+    """Server net: consumes the client feature map [B,32,32,16] and runs the
+    32- and 64-filter stages (strided) + classifier head."""
+
+    blocks_per_stage: int = 9
+    output_dim: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, f, train: bool = False):
+        x = f.astype(self.dtype)
+        for stage, filters in enumerate((32, 64)):
+            for block in range(self.blocks_per_stage):
+                strides = 2 if block == 0 else 1
+                x = BasicBlock(filters, strides, dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+@dataclass
+class GKTHalfBundle:
+    """init/apply pure-function wrapper for one half of the split pair
+    (plays the ModelBundle role; separate class because the client half
+    returns a (logits, features) tuple)."""
+
+    name: str
+    module: nn.Module
+    input_shape: tuple
+    input_dtype: Any = jnp.float32
+
+    def init(self, rng: jax.Array, batch_size: int = 2) -> dict:
+        x = jnp.zeros((batch_size,) + tuple(self.input_shape), self.input_dtype)
+        return self.module.init({"params": rng}, x, train=False)
+
+    def apply_train(self, variables: dict, x: jax.Array):
+        out, updated = self.module.apply(
+            variables, x, train=True, mutable=["batch_stats"]
+        )
+        new_vars = dict(variables)
+        new_vars.update(updated)
+        return out, new_vars
+
+    def apply_eval(self, variables: dict, x: jax.Array):
+        return self.module.apply(variables, x, train=False)
+
+
+@dataclass
+class GKTPair:
+    client: GKTHalfBundle
+    server: GKTHalfBundle
+    feature_shape: tuple          # single-example feature-map shape
+
+
+def create_gkt_pair(
+    output_dim: int = 10,
+    input_shape: tuple = (32, 32, 3),
+    client_blocks: int = 3,
+    server_blocks_per_stage: int = 9,
+    dtype=jnp.float32,
+) -> GKTPair:
+    """Defaults mirror the reference pair resnet8_56 (client,
+    resnet_client.py:230) + resnet56_server (resnet_server.py); pass smaller
+    block counts for CI-sized nets."""
+    feature_shape = tuple(input_shape[:-1]) + (16,)
+    return GKTPair(
+        client=GKTHalfBundle(
+            name="gkt_client",
+            module=GKTClientNet(client_blocks, output_dim, dtype=dtype),
+            input_shape=tuple(input_shape),
+        ),
+        server=GKTHalfBundle(
+            name="gkt_server",
+            module=GKTServerNet(server_blocks_per_stage, output_dim, dtype=dtype),
+            input_shape=feature_shape,
+        ),
+        feature_shape=feature_shape,
+    )
