@@ -1,0 +1,362 @@
+"""Classical vertical federated learning (feature-partitioned parties).
+
+Counterpart of reference fedml_api/standalone/classical_vertical_fl/:
+``VerticalMultiplePartyLogisticRegressionFederatedLearning.fit`` (vfl.py:21-50)
+runs, per batch: hosts send logit components, the guest sums them with its
+own, computes BCE loss and the COMMON GRADIENT dL/dU (party_models.py:57-69),
+sends it back, and every party backprops its local stack from that gradient.
+Party stacks mirror finance/vfl_models_standalone.py: local layer =
+Linear+LeakyReLU, head = Linear to 1 logit (bias only on the guest), each
+party an SGD(momentum=0.9, wd=0.01) optimizer.
+
+Three executions of the same math, sharing one init:
+
+1. **fused** — the TPU-first path: the whole multi-party step is ONE jitted
+   program; ``jax.grad`` through the summed logit IS the common-gradient
+   relay (autodiff computes dL/dU once and routes it to every party's
+   subtree), so no wire and no Python protocol remain.
+2. **sharded** — same step under ``shard_map`` over a "party" mesh axis with
+   dim-padded parties and a ``psum`` of logit contributions: the SPMD
+   feature-sharded form (SURVEY.md §2.6.4) that scales parties across chips.
+3. **protocol** — explicit Guest/Host party objects exchanging components
+   and the common gradient, for the genuinely-distributed edge deployment
+   (and as the executable spec the fused forms are tested against).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.data.vertical import VerticalDataset
+
+
+def _party_optimizer(lr: float) -> optax.GradientTransformation:
+    # torch.optim.SGD(momentum=0.9, weight_decay=0.01) semantics
+    # (vfl_models_standalone.py:13,46)
+    return optax.chain(optax.add_decayed_weights(0.01), optax.sgd(lr, momentum=0.9))
+
+
+def init_party_params(
+    rng: jax.Array, input_dim: int, hidden_dim: int, guest: bool
+) -> dict:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    lim1 = 1.0 / np.sqrt(input_dim)
+    lim2 = 1.0 / np.sqrt(hidden_dim)
+    p = {
+        "local_w": jax.random.uniform(k1, (input_dim, hidden_dim), minval=-lim1, maxval=lim1),
+        "local_b": jnp.zeros((hidden_dim,)),
+        "head_w": jax.random.uniform(k2, (hidden_dim, 1), minval=-lim2, maxval=lim2),
+    }
+    if guest:
+        p["head_b"] = jnp.zeros((1,))
+    return p
+
+
+def party_component(params: dict, x: jax.Array) -> jax.Array:
+    """One party's logit contribution U_p [B, 1]."""
+    z = jax.nn.leaky_relu(x @ params["local_w"] + params["local_b"])
+    u = z @ params["head_w"]
+    if "head_b" in params:
+        u = u + params["head_b"]
+    return u
+
+
+def bce_with_logits(u: jax.Array, y: jax.Array) -> jax.Array:
+    l = u.astype(jnp.float32)
+    t = y.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(l, 0.0) - l * t + jnp.log1p(jnp.exp(-jnp.abs(l))))
+
+
+class VFLAPI:
+    """Fused standalone VFL (execution 1); ``use_mesh_sharding`` switches the
+    step to the shard_map form (execution 2) when a party-axis mesh is
+    available."""
+
+    def __init__(
+        self,
+        dataset: VerticalDataset,
+        hidden_dim: int = 16,
+        lr: float = 0.01,
+        batch_size: int = 64,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.hidden = hidden_dim
+        self.lr = lr
+        self.batch_size = batch_size
+        root = jax.random.PRNGKey(seed)
+        keys = jax.random.split(root, dataset.num_parties)
+        self.params = [
+            init_party_params(keys[p], d, hidden_dim, guest=(p == 0))
+            for p, d in enumerate(dataset.party_dims)
+        ]
+        self._tx = _party_optimizer(lr)
+        self.opt_states = [self._tx.init(p) for p in self.params]
+        self._step = self._build_step()
+        self.history: list[dict] = []
+
+    def _build_step(self):
+        tx = self._tx
+
+        @jax.jit
+        def step(params_list, opt_list, xs, y):
+            def loss_fn(plist):
+                u = sum(party_component(p, x) for p, x in zip(plist, xs))
+                return bce_with_logits(u[:, 0], y)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params_list)
+            new_params, new_opts = [], []
+            for p, o, g in zip(params_list, opt_list, grads):
+                upd, no = tx.update(g, o, p)
+                new_params.append(optax.apply_updates(p, upd))
+                new_opts.append(no)
+            return new_params, new_opts, loss
+
+        return step
+
+    def fit(self, epochs: int = 10, seed: int = 0) -> dict:
+        d = self.dataset
+        n = len(d.train_y)
+        bs = min(self.batch_size, n)
+        steps = n // bs
+        rng = np.random.default_rng(seed)
+        xs_all = [jnp.asarray(p) for p in d.train_parts]
+        y_all = jnp.asarray(d.train_y)
+        last = {}
+        for ep in range(epochs):
+            order = rng.permutation(n)[: steps * bs].reshape(steps, bs)
+            losses = []
+            for b in range(steps):
+                idx = jnp.asarray(order[b])
+                xs = [x[idx] for x in xs_all]
+                self.params, self.opt_states, loss = self._step(
+                    self.params, self.opt_states, xs, y_all[idx]
+                )
+                losses.append(float(loss))
+            last = {"epoch": ep, "Train/Loss": float(np.mean(losses)), **self.evaluate()}
+            self.history.append(last)
+        return last
+
+    def predict_logits(self, parts: Sequence[np.ndarray]) -> np.ndarray:
+        u = sum(
+            party_component(p, jnp.asarray(x)) for p, x in zip(self.params, parts)
+        )
+        return np.asarray(u[:, 0])
+
+    def evaluate(self) -> dict:
+        d = self.dataset
+        u = self.predict_logits(d.test_parts)
+        pred = (u > 0).astype(np.float32)
+        return {
+            "Test/Acc": float((pred == d.test_y).mean()),
+            "Test/Loss": float(bce_with_logits(jnp.asarray(u), jnp.asarray(d.test_y))),
+        }
+
+
+# --------------------------------------------------------------------------
+# Execution 2: SPMD feature-sharded step over a "party" mesh axis.
+# --------------------------------------------------------------------------
+
+def pad_party_params(params_list: list[dict], party_dims: Sequence[int]) -> dict:
+    """Stack per-party params into one pytree [P, ...] with input dims
+    zero-padded to max(party_dims); guest bias becomes a masked row."""
+    P = len(params_list)
+    d_max = max(party_dims)
+    hid = params_list[0]["local_w"].shape[1]
+    local_w = jnp.zeros((P, d_max, hid))
+    for p, prm in enumerate(params_list):
+        local_w = local_w.at[p, : party_dims[p]].set(prm["local_w"])
+    return {
+        "local_w": local_w,
+        "local_b": jnp.stack([p["local_b"] for p in params_list]),
+        "head_w": jnp.stack([p["head_w"] for p in params_list]),
+        "head_b": jnp.stack(
+            [params_list[p].get("head_b", jnp.zeros((1,))) for p in range(P)]
+        ),
+        "head_b_mask": jnp.array([1.0] + [0.0] * (P - 1))[:, None],
+    }
+
+
+def make_sharded_vfl_step(mesh, lr: float, axis: str = "party"):
+    """Build the shard_map step: each device holds one party's padded slice;
+    the only cross-party communication is a psum of [B,1] logit
+    contributions and the implicit psum of the common gradient on the
+    backward pass — the reference's whole message protocol (vfl.py:30-48)
+    becomes two ICI collectives."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    tx = _party_optimizer(lr)
+
+    def per_party_loss(params, x, y):
+        z = jax.nn.leaky_relu(x @ params["local_w"] + params["local_b"])
+        # the mask is structural (guest-only bias), not a trainable leaf
+        bias_mask = jax.lax.stop_gradient(params["head_b_mask"])
+        u = z @ params["head_w"] + params["head_b"] * bias_mask
+        u_total = jax.lax.psum(u, axis)            # [B,1] summed over parties
+        return bce_with_logits(u_total[:, 0], y)
+
+    def step(stacked_params, stacked_opt, xs_padded, y):
+        # shard_map body: leading party axis is sharded away
+        def body(params, opt, x, y):
+            params = jax.tree.map(lambda a: a[0], params)
+            opt = jax.tree.map(lambda a: a[0], opt)
+            x = x[0]
+            loss, grads = jax.value_and_grad(
+                lambda p: per_party_loss(p, x, y)
+            )(params)
+            upd, new_opt = tx.update(grads, opt, params)
+            # freeze the structural mask entirely (no grad, no weight decay)
+            upd["head_b_mask"] = jnp.zeros_like(upd["head_b_mask"])
+            new_params = optax.apply_updates(params, upd)
+            one = lambda t: jax.tree.map(lambda a: a[None], t)
+            return one(new_params), one(new_opt), loss[None]
+
+        sharded = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P()),
+            out_specs=(P(axis), P(axis), P(axis)),
+        )
+        new_params, new_opt, losses = sharded(stacked_params, stacked_opt, xs_padded, y)
+        return new_params, new_opt, losses[0]
+
+    return jax.jit(step), tx
+
+
+# --------------------------------------------------------------------------
+# Execution 3: explicit guest/host protocol objects (edge deployment).
+# --------------------------------------------------------------------------
+
+class VFLHostParty:
+    """Host: no labels; sends logit components, learns from the common
+    gradient (party_models.py:81-120)."""
+
+    def __init__(self, params: dict, lr: float):
+        self.params = params
+        self._tx = _party_optimizer(lr)
+        self.opt_state = self._tx.init(params)
+        self._x = None
+
+        @jax.jit
+        def backward(params, opt_state, x, common_grad):
+            def fwd(p):
+                return party_component(p, x)
+            _, vjp = jax.vjp(fwd, params)
+            (grads,) = vjp(common_grad)
+            upd, new_opt = self._tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, upd), new_opt
+
+        self._backward = backward
+
+    def set_batch(self, x: np.ndarray):
+        self._x = jnp.asarray(x)
+
+    def send_components(self) -> jax.Array:
+        return party_component(self.params, self._x)
+
+    def receive_gradients(self, common_grad: jax.Array):
+        self.params, self.opt_state = self._backward(
+            self.params, self.opt_state, self._x, common_grad
+        )
+
+    def predict(self, x: np.ndarray) -> jax.Array:
+        return party_component(self.params, jnp.asarray(x))
+
+
+class VFLGuestParty:
+    """Guest: holds labels; fuses components, computes loss + common grad
+    dL/dU, updates its own stack (party_models.py:12-78)."""
+
+    def __init__(self, params: dict, lr: float):
+        self.params = params
+        self._tx = _party_optimizer(lr)
+        self.opt_state = self._tx.init(params)
+        self._x = self._y = None
+        self._components: list[jax.Array] = []
+        self.loss = None
+
+        @jax.jit
+        def fit_fn(params, opt_state, x, y, others_sum):
+            def loss_fn(p):
+                u = party_component(p, x) + others_sum
+                return bce_with_logits(u[:, 0], y)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            # common gradient dL/dU — what every party backprops from
+            u_total = party_component(params, x) + others_sum
+            common = jax.grad(
+                lambda u: bce_with_logits(u[:, 0], y)
+            )(u_total)
+            upd, new_opt = self._tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, upd), new_opt, loss, common
+
+        self._fit = fit_fn
+
+    def set_batch(self, x: np.ndarray, y: np.ndarray):
+        self._x, self._y = jnp.asarray(x), jnp.asarray(y)
+
+    def receive_components(self, component_list: Sequence[jax.Array]):
+        self._components = list(component_list)
+
+    def fit(self):
+        others = sum(self._components) if self._components else 0.0
+        self.params, self.opt_state, loss, self._common = self._fit(
+            self.params, self.opt_state, self._x, self._y, others
+        )
+        self.loss = float(loss)
+        self._components = []
+
+    def send_gradients(self) -> jax.Array:
+        return self._common
+
+    def predict(self, x: np.ndarray, component_list: Sequence[jax.Array]) -> np.ndarray:
+        u = party_component(self.params, jnp.asarray(x)) + sum(component_list)
+        return np.asarray(jax.nn.sigmoid(u[:, 0]))
+
+
+class VerticalFederatedLearning:
+    """Coordinator mirroring reference vfl.py:21-55 fit/predict."""
+
+    def __init__(self, guest: VFLGuestParty, hosts: dict):
+        self.guest = guest
+        self.hosts = dict(hosts)
+
+    def fit(self, X_guest, y, host_X_dict, global_step: int = 0) -> float:
+        self.guest.set_batch(X_guest, y)
+        for hid, x in host_X_dict.items():
+            self.hosts[hid].set_batch(x)
+        self.guest.receive_components(
+            [h.send_components() for h in self.hosts.values()]
+        )
+        self.guest.fit()
+        common = self.guest.send_gradients()
+        for h in self.hosts.values():
+            h.receive_gradients(common)
+        return self.guest.loss
+
+    def predict(self, X_guest, host_X_dict) -> np.ndarray:
+        comps = [self.hosts[h].predict(x) for h, x in host_X_dict.items()]
+        return self.guest.predict(X_guest, comps)
+
+
+def build_protocol_vfl(
+    dataset: VerticalDataset, hidden_dim: int = 16, lr: float = 0.01, seed: int = 0
+) -> VerticalFederatedLearning:
+    root = jax.random.PRNGKey(seed)
+    keys = jax.random.split(root, dataset.num_parties)
+    guest = VFLGuestParty(
+        init_party_params(keys[0], dataset.party_dims[0], hidden_dim, guest=True), lr
+    )
+    hosts = {
+        p: VFLHostParty(
+            init_party_params(keys[p], dataset.party_dims[p], hidden_dim, guest=False), lr
+        )
+        for p in range(1, dataset.num_parties)
+    }
+    return VerticalFederatedLearning(guest, hosts)

@@ -1,0 +1,110 @@
+"""TurboAggregate MPC tests (reference standalone/turboaggregate/mpc_function.py).
+
+Exact-math properties:
+- modular_inv(a) * a == 1 mod p,
+- BGW decode(encode(X)) == X from any T+1 shares,
+- LCC decode(encode(X)) == X from any K+T evaluations,
+- additive shares sum to the secret,
+- the secure weighted sum equals the plain weighted mean to quantization
+  tolerance, and the full TA federated run matches FedAvg closely.
+"""
+
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.turboaggregate import (
+    P_DEFAULT,
+    TurboAggregateAPI,
+    additive_shares,
+    bgw_decode,
+    bgw_encode,
+    dequantize,
+    lcc_decode,
+    lcc_encode,
+    modular_inv,
+    quantize,
+    secure_weighted_sum,
+)
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.synthetic import make_synthetic_classification
+
+
+def test_modular_inverse():
+    rng = np.random.default_rng(0)
+    a = rng.integers(1, int(P_DEFAULT), size=50, dtype=np.int64)
+    inv = modular_inv(a)
+    assert np.all(np.mod(a * inv, P_DEFAULT) == 1)
+
+
+def test_bgw_roundtrip():
+    rng = np.random.default_rng(1)
+    X = rng.integers(0, int(P_DEFAULT), size=(4, 6), dtype=np.int64)
+    N, T = 7, 2
+    shares = bgw_encode(X, N, T, rng=rng)
+    # any T+1 shares reconstruct
+    for idx in ([0, 1, 2], [2, 4, 6], [1, 3, 5]):
+        rec = bgw_decode(shares[idx], idx)
+        np.testing.assert_array_equal(rec, X)
+
+
+def test_lcc_roundtrip():
+    rng = np.random.default_rng(2)
+    K, T, N = 3, 1, 8
+    X = rng.integers(0, int(P_DEFAULT), size=(6, 5), dtype=np.int64)
+    enc = lcc_encode(X, N, K, T, rng=rng)
+    for idx in ([0, 1, 2, 3], [4, 5, 6, 7], [0, 2, 4, 6]):
+        rec = lcc_decode(enc[idx], N, K, T, idx)
+        np.testing.assert_array_equal(rec.reshape(X.shape), X)
+
+
+def test_lcc_points_disjoint():
+    """Privacy precondition: no worker may be evaluated at a data beta, or
+    it receives a raw secret chunk (reference defect fixed, not replicated)."""
+    from fedml_tpu.algorithms.turboaggregate import _lcc_points
+
+    for (N, K, T) in [(8, 3, 1), (5, 2, 2), (10, 4, 3)]:
+        alphas, betas = _lcc_points(N, K, T, P_DEFAULT)
+        assert not set(alphas.tolist()) & set(betas.tolist())
+
+
+def test_additive_shares_sum():
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, int(P_DEFAULT), size=12, dtype=np.int64)
+    sh = additive_shares(x, 5, rng=rng)
+    np.testing.assert_array_equal(np.mod(sh.sum(axis=0), P_DEFAULT), x)
+
+
+def test_quantization_roundtrip():
+    rng = np.random.default_rng(4)
+    x = rng.normal(0, 1, 100)
+    np.testing.assert_allclose(dequantize(quantize(x)), x, atol=1e-5)
+
+
+def test_secure_weighted_sum_matches_plain():
+    rng = np.random.default_rng(5)
+    C, D = 8, 40
+    vec = rng.normal(0, 1, (C, D))
+    w = rng.uniform(0.5, 2.0, C)
+    w = w / w.sum()
+    secure = secure_weighted_sum(vec, w, group_size=2, seed=6)
+    plain = (vec * w[:, None]).sum(axis=0)
+    np.testing.assert_allclose(secure, plain, atol=1e-4)
+
+
+def test_turboaggregate_federated_matches_fedavg():
+    ds = make_synthetic_classification(
+        "ta", (8,), 3, 6, records_per_client=12,
+        partition_method="homo", batch_size=6, seed=0,
+    )
+    cfg = FedConfig(
+        model="lr", client_num_in_total=6, client_num_per_round=6,
+        comm_round=3, epochs=1, batch_size=6, lr=0.2, seed=1,
+        frequency_of_the_test=100,
+    )
+    ta = TurboAggregateAPI(ds, cfg)
+    fa = FedAvgAPI(ds, cfg)
+    ta.train()
+    fa.train()
+    import jax
+    for a, b in zip(jax.tree.leaves(ta.variables), jax.tree.leaves(fa.variables)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
