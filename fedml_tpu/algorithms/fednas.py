@@ -1,0 +1,258 @@
+"""FedNAS — federated DARTS architecture search.
+
+Counterpart of reference fedml_api/distributed/fednas/: every client runs
+local differentiable architecture search — alternating architecture (alpha)
+steps and weight steps (FedNASTrainer.local_search:82+, single-level mode =
+architect.step_single_level:107-125) — and the server aggregates BOTH weight
+and alpha pytrees by sample-weighted averaging
+(FedNASAggregator.aggregate/__aggregate_alpha:70-107), recording the derived
+genotype each round (record_model_global_architecture:173).
+
+TPU re-design: because alphas are plain inputs of the pure search network
+(models/darts.py), the client's search step is one jitted scan — alpha-grad
+and weight-grad are two ``jax.grad`` argnums of the same function — and the
+whole cohort searches under one ``vmap``. Aggregating alphas is the same
+``tree_weighted_mean`` used for weights; no separate message type needed
+(reference message_define.py MSG_ARG_KEY_ARCHS).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.rng import round_key, sample_clients, seed_everything
+from fedml_tpu.core.tasks import int_cross_entropy
+from fedml_tpu.data import FedDataset
+from fedml_tpu.models.darts import (
+    DartsNetwork,
+    DartsSearchNetwork,
+    derive_genotype,
+    init_alphas,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _masked_ce(logits, labels, mask):
+    per = int_cross_entropy(logits, labels)
+    m = mask.astype(jnp.float32)
+    return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+class FedNASAPI:
+    """Standalone-simulation FedNAS search phase."""
+
+    def __init__(
+        self,
+        dataset: FedDataset,
+        config: FedConfig,
+        channels: int = 8,
+        layers: int = 4,
+        steps: int = 2,
+        multiplier: int = 2,
+        arch_lr: float = 3e-4,
+        arch_wd: float = 1e-3,
+    ):
+        self.dataset = dataset
+        self.config = config
+        self.steps_cfg = steps
+        self.multiplier = multiplier
+        self.module = DartsSearchNetwork(
+            channels=channels, layers=layers, steps=steps,
+            multiplier=multiplier, output_dim=dataset.class_num,
+        )
+        self.root_key = seed_everything(config.seed)
+        ex = jnp.zeros((2,) + tuple(dataset.train_x.shape[2:]), jnp.float32)
+        self.alphas = init_alphas(jax.random.fold_in(self.root_key, 7), steps)
+        self.variables = self.module.init(
+            {"params": jax.random.fold_in(self.root_key, 8)}, ex, self.alphas,
+            train=False,
+        )
+        # weight optimizer: SGD momentum 0.9 wd 3e-4 (reference main_fednas
+        # defaults); arch optimizer: Adam lr 3e-4 wd 1e-3 (architect.py:23-27)
+        self._wtx = optax.chain(
+            optax.add_decayed_weights(3e-4),
+            optax.sgd(config.lr, momentum=0.9),
+        )
+        self._atx = optax.chain(
+            optax.add_decayed_weights(arch_wd), optax.adam(arch_lr)
+        )
+        self._search_round = self._build_search_round()
+        self._eval_fn = self._build_eval()
+        self.genotypes: list = []
+        self.history: list[dict] = []
+
+    def _build_search_round(self):
+        module, cfg = self.module, self.config
+        wtx, atx = self._wtx, self._atx
+        bs = cfg.batch_size
+        n_pad = int(self.dataset.train_x.shape[1])
+        steps = n_pad // bs
+        epochs = cfg.epochs
+
+        def local_search(variables, alphas, x, y, mask, count, rng):
+            wopt = wtx.init(variables["params"])
+            aopt = atx.init(alphas)
+            steps_real = jnp.ceil(count.astype(jnp.float32) / bs).astype(jnp.int32)
+
+            def epoch_fn(carry, ekey):
+                variables, alphas, wopt, aopt = carry
+                perm = jax.random.permutation(ekey, n_pad)
+                order = perm[jnp.argsort(-mask[perm], stable=True)]
+                xs = x[order].reshape((steps, bs) + x.shape[1:])
+                ys = y[order].reshape((steps, bs))
+                ms = mask[order].reshape((steps, bs))
+
+                def step_fn(carry, batch):
+                    variables, alphas, wopt, aopt = carry
+                    bx, by, bm, step_idx = batch
+                    live = (step_idx < steps_real).astype(jnp.float32)
+
+                    def loss_of(p, a):
+                        vin = dict(variables)
+                        vin["params"] = p
+                        logits, new_vars = module.apply(
+                            vin, bx, a, train=True, mutable=["batch_stats"]
+                        )
+                        return _masked_ce(logits, by, bm), new_vars
+
+                    # 1) architecture step (single-level: same batch,
+                    #    architect.step_single_level:107-125)
+                    a_grads = jax.grad(
+                        lambda a: loss_of(variables["params"], a)[0]
+                    )(alphas)
+                    a_upd, new_aopt = atx.update(a_grads, aopt, alphas)
+                    new_alphas = optax.apply_updates(alphas, a_upd)
+
+                    # 2) weight step with the updated alphas
+                    (l, new_vars), w_grads = jax.value_and_grad(
+                        lambda p: loss_of(p, new_alphas), has_aux=True
+                    )(variables["params"])
+                    # reference main_fednas default --grad_clip is 5; a
+                    # configured FedConfig.grad_clip overrides it
+                    clip = cfg.grad_clip if cfg.grad_clip else 5.0
+                    gn = optax.global_norm(w_grads)
+                    scale = jnp.minimum(1.0, clip / jnp.maximum(gn, 1e-12))
+                    w_grads = jax.tree.map(lambda g: g * scale, w_grads)
+                    w_upd, new_wopt = wtx.update(w_grads, wopt, variables["params"])
+                    new_params = optax.apply_updates(variables["params"], w_upd)
+
+                    def freeze(new, old):
+                        return jax.tree.map(
+                            lambda n, o: live * n + (1.0 - live) * o
+                            if jnp.issubdtype(n.dtype, jnp.floating)
+                            else jnp.where(live > 0, n, o),
+                            new, old,
+                        )
+
+                    out_vars = dict(freeze(
+                        {k: v for k, v in new_vars.items() if k != "params"},
+                        {k: v for k, v in variables.items() if k != "params"},
+                    ))
+                    out_vars["params"] = freeze(new_params, variables["params"])
+                    return (
+                        out_vars,
+                        freeze(new_alphas, alphas),
+                        freeze(new_wopt, wopt),
+                        freeze(new_aopt, aopt),
+                    ), l * live
+
+                carry, losses = jax.lax.scan(
+                    step_fn, (variables, alphas, wopt, aopt),
+                    (xs, ys, ms, jnp.arange(steps)),
+                )
+                loss = jnp.sum(losses) / jnp.maximum(steps_real.astype(jnp.float32), 1.0)
+                return carry, loss
+
+            (variables, alphas, _, _), ep_losses = jax.lax.scan(
+                epoch_fn, (variables, alphas, wopt, aopt),
+                jax.random.split(rng, epochs),
+            )
+            return variables, alphas, ep_losses[-1]
+
+        @jax.jit
+        def search_round(variables, alphas, cx, cy, cm, counts, rng):
+            keys = jax.random.split(rng, cx.shape[0])
+            new_vars, new_alphas, losses = jax.vmap(
+                local_search, in_axes=(None, None, 0, 0, 0, 0, 0)
+            )(variables, alphas, cx, cy, cm, counts, keys)
+            agg_vars = tree_weighted_mean(new_vars, counts)
+            agg_alphas = tree_weighted_mean(new_alphas, counts)
+            train_loss = jnp.sum(losses * counts) / jnp.sum(counts)
+            return agg_vars, agg_alphas, train_loss
+
+        return search_round
+
+    def _build_eval(self):
+        module = self.module
+
+        @jax.jit
+        def evaluate(variables, alphas, x, y, mask):
+            logits = module.apply(variables, x, alphas, train=False)
+            pred = jnp.argmax(logits, axis=-1)
+            m = mask.astype(jnp.float32)
+            per = int_cross_entropy(logits, y)
+            return {
+                "correct": jnp.sum((pred == y).astype(jnp.float32) * m),
+                "loss_sum": jnp.sum(per * m),
+                "count": jnp.sum(m),
+            }
+
+        return evaluate
+
+    def train(self) -> dict:
+        d, cfg = self.dataset, self.config
+        last = {}
+        t0 = time.time()
+        for rnd in range(cfg.comm_round):
+            population = min(cfg.client_num_in_total, d.num_clients)
+            sampled = sample_clients(
+                rnd, population, min(cfg.client_num_per_round, population),
+                seed=cfg.seed,
+            )
+            cx, cy, cm, counts = d.client_slice(sampled)
+            rk = round_key(self.root_key, rnd)
+            self.variables, self.alphas, loss = self._search_round(
+                self.variables, self.alphas, cx, cy, cm,
+                jnp.asarray(counts, jnp.float32), rk,
+            )
+            g = derive_genotype(self.alphas, self.steps_cfg, self.multiplier)
+            self.genotypes.append(g)
+            if rnd % cfg.frequency_of_the_test == 0 or rnd == cfg.comm_round - 1:
+                sums = jax.device_get(self._eval_fn(
+                    self.variables, self.alphas,
+                    jnp.asarray(d.test_x), jnp.asarray(d.test_y),
+                    jnp.asarray(d.test_mask),
+                ))
+                acc = float(sums["correct"]) / max(float(sums["count"]), 1.0)
+                last = {
+                    "round": rnd, "Test/Acc": acc,
+                    "Test/Loss": float(sums["loss_sum"]) / max(float(sums["count"]), 1.0),
+                    "Train/Loss": float(loss),
+                    "genotype": g,
+                }
+                self.history.append(last)
+                log.info("FedNAS round %d acc %.4f genotype %s", rnd, acc, g)
+        if self.history:
+            self.history[-1]["rounds_per_sec"] = cfg.comm_round / (time.time() - t0)
+        return last
+
+    def build_discrete_network(self, channels: int = 16, layers: int = 8) -> DartsNetwork:
+        """FedNAS phase 2: the searched genotype becomes a fixed network for
+        federated training (reference search -> train pipeline)."""
+        g = self.genotypes[-1] if self.genotypes else derive_genotype(
+            self.alphas, self.steps_cfg, self.multiplier
+        )
+        return DartsNetwork(
+            genotype=g, channels=channels, layers=layers,
+            output_dim=self.dataset.class_num,
+        )

@@ -104,8 +104,12 @@ def bgw_decode(
     shares: np.ndarray, worker_idx: Sequence[int], p: np.int64 = P_DEFAULT
 ) -> np.ndarray:
     """Reconstruct the secret from >=T+1 shares by Lagrange interpolation at
-    0 (mpc_function.py:79-108)."""
+    0 (mpc_function.py:79-108). The degree-T polynomial needs T+1 points;
+    fewer would interpolate a lower-degree polynomial through the wrong
+    value — callers must know reconstruction failed, not get garbage."""
     worker_idx = np.asarray(worker_idx)
+    if shares.shape[0] != len(worker_idx):
+        raise ValueError("one share per worker index required")
     alphas = np.mod(worker_idx + 1, p).astype(np.int64)   # alpha_i = i + 1
     lam = lagrange_coeffs(np.zeros(1, np.int64), alphas, p)[0]   # [R]
     flat = shares.reshape(len(worker_idx), -1)
@@ -162,7 +166,12 @@ def lcc_decode(
 ) -> np.ndarray:
     """Interpolate the chunk values back from evaluations at the surviving
     workers' points (mpc_function.py:197-213). For degree-1 (identity)
-    computations any K+T workers suffice."""
+    computations any K+T workers suffice — and no fewer: the encoding
+    polynomial has degree K+T-1."""
+    if len(worker_idx) < K + T:
+        raise ValueError(
+            f"LCC reconstruction needs >= K+T = {K + T} shares, got {len(worker_idx)}"
+        )
     alphas, betas = _lcc_points(N, K, T, p)
     eval_pts = alphas[np.asarray(worker_idx)]
     U = lagrange_coeffs(betas[:K], eval_pts, p)           # [K, R]

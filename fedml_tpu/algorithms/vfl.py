@@ -190,7 +190,7 @@ def make_sharded_vfl_step(mesh, lr: float, axis: str = "party"):
     backward pass — the reference's whole message protocol (vfl.py:30-48)
     becomes two ICI collectives."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     tx = _party_optimizer(lr)
 
@@ -328,6 +328,11 @@ class VerticalFederatedLearning:
         self.hosts = dict(hosts)
 
     def fit(self, X_guest, y, host_X_dict, global_step: int = 0) -> float:
+        if set(host_X_dict) != set(self.hosts):
+            raise ValueError(
+                f"host_X_dict must cover every host: have {sorted(self.hosts)}, "
+                f"got {sorted(host_X_dict)}"
+            )
         self.guest.set_batch(X_guest, y)
         for hid, x in host_X_dict.items():
             self.hosts[hid].set_batch(x)

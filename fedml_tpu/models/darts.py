@@ -1,0 +1,363 @@
+"""DARTS search space in flax (for FedNAS).
+
+Counterpart of reference fedml_api/model/cv/darts/{operations.py,
+model_search.py, model.py, genotypes.py}: the 8-primitive mixed-op cell
+search space (operations.py:4-20), the over-parameterized search network
+(model_search.py:172-257), genotype derivation (model_search.py:258-297),
+and the discrete network built from a genotype (model.py).
+
+JAX re-design:
+- architecture parameters (alphas) are NOT flax params — they are a separate
+  pytree passed as an input to ``apply``. That makes DARTS' bilevel structure
+  native: ``jax.grad`` w.r.t. weights and w.r.t. alphas are two argnums of
+  the same pure function, no parameter-group bookkeeping
+  (architect.py:15-30's concat/clone machinery disappears),
+- every mixed op evaluates all primitives and contracts with softmax(alpha)
+  — a dense weighted sum XLA fuses well; there is no dynamic op dispatch,
+- BatchNorms in the search net are affine-free (reference affine=False) and
+  use running stats only at eval.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PRIMITIVES = (
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+)
+
+
+class Genotype(NamedTuple):
+    normal: list          # [(op_name, input_node), ...]
+    normal_concat: list
+    reduce: list
+    reduce_concat: list
+
+
+def num_edges(steps: int) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+# ---------------------------------------------------------------- primitives
+
+def _bn(train: bool):
+    return nn.BatchNorm(
+        use_running_average=not train, momentum=0.9,
+        use_scale=False, use_bias=False,
+    )
+
+
+def _avg_pool_3x3(x, stride):
+    """count_include_pad=False semantics (operations.py:6): divide by the
+    number of REAL elements under the window."""
+    ones = jnp.ones_like(x[..., :1])
+    s = nn.avg_pool(x, (3, 3), strides=(stride, stride), padding="SAME")
+    c = nn.avg_pool(ones, (3, 3), strides=(stride, stride), padding="SAME")
+    return s / jnp.maximum(c, 1e-12)
+
+
+def _max_pool_3x3(x, stride):
+    return nn.max_pool(x, (3, 3), strides=(stride, stride), padding="SAME")
+
+
+class ReLUConvBN(nn.Module):
+    c_out: int
+    kernel: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        x = nn.Conv(self.c_out, (self.kernel, self.kernel),
+                    strides=(self.stride, self.stride), padding="SAME",
+                    use_bias=False)(x)
+        return _bn(train)(x)
+
+
+class DilConv(nn.Module):
+    c_out: int
+    kernel: int
+    stride: int
+    dilation: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c_in = x.shape[-1]
+        x = nn.relu(x)
+        x = nn.Conv(c_in, (self.kernel, self.kernel),
+                    strides=(self.stride, self.stride), padding="SAME",
+                    kernel_dilation=(self.dilation, self.dilation),
+                    feature_group_count=c_in, use_bias=False)(x)
+        x = nn.Conv(self.c_out, (1, 1), use_bias=False)(x)
+        return _bn(train)(x)
+
+
+class SepConv(nn.Module):
+    c_out: int
+    kernel: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c_in = x.shape[-1]
+        for i, stride in enumerate((self.stride, 1)):
+            x = nn.relu(x)
+            x = nn.Conv(c_in, (self.kernel, self.kernel),
+                        strides=(stride, stride), padding="SAME",
+                        feature_group_count=c_in, use_bias=False)(x)
+            x = nn.Conv(c_in if i == 0 else self.c_out, (1, 1), use_bias=False)(x)
+            x = _bn(train)(x)
+        return x
+
+
+class FactorizedReduce(nn.Module):
+    c_out: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        a = nn.Conv(self.c_out // 2, (1, 1), strides=(2, 2), use_bias=False)(x)
+        b = nn.Conv(self.c_out // 2, (1, 1), strides=(2, 2), use_bias=False)(
+            jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))[:, 1:, 1:, :]
+        )
+        return _bn(train)(jnp.concatenate([a, b], axis=-1))
+
+
+def _zero(x, stride):
+    if stride == 1:
+        return x * 0.0
+    return x[:, ::stride, ::stride, :] * 0.0
+
+
+class MixedOp(nn.Module):
+    """All 8 primitives evaluated, contracted with the edge's softmax weights
+    (model_search.py:10-23). Pool ops get a trailing affine-free BN like the
+    reference (model_search.py:17-18)."""
+
+    channels: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, weights, train: bool = False):
+        c = self.channels
+        outs = [
+            _zero(x, self.stride),
+            _bn(train)(_max_pool_3x3(x, self.stride)),
+            _bn(train)(_avg_pool_3x3(x, self.stride)),
+            x if self.stride == 1 else FactorizedReduce(c)(x, train),
+            SepConv(c, 3, self.stride)(x, train),
+            SepConv(c, 5, self.stride)(x, train),
+            DilConv(c, 3, self.stride, 2)(x, train),
+            DilConv(c, 5, self.stride, 2)(x, train),
+        ]
+        stacked = jnp.stack(outs, axis=0)           # [n_ops, B, H, W, C]
+        return jnp.einsum("o,obhwc->bhwc", weights, stacked)
+
+
+class SearchCell(nn.Module):
+    """DAG cell: `steps` intermediate nodes, each summing mixed-op edges from
+    all predecessors; output = concat of the last `multiplier` nodes
+    (model_search.py:26-60)."""
+
+    steps: int
+    multiplier: int
+    channels: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train: bool = False):
+        c = self.channels
+        if self.reduction_prev:
+            s0 = FactorizedReduce(c)(s0, train)
+        else:
+            s0 = ReLUConvBN(c)(s0, train)
+        s1 = ReLUConvBN(c)(s1, train)
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            s = sum(
+                MixedOp(c, 2 if self.reduction and j < 2 else 1)(
+                    h, weights[offset + j], train
+                )
+                for j, h in enumerate(states)
+            )
+            offset += len(states)
+            states.append(s)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+class DartsSearchNetwork(nn.Module):
+    """Over-parameterized search net (model_search.py:172-231): stem, cells
+    with reductions at 1/3 and 2/3 depth, global pool + classifier. Alphas
+    arrive as inputs: {'normal': [k, 8], 'reduce': [k, 8]}."""
+
+    channels: int = 16
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, alphas: dict, train: bool = False):
+        w_normal = jax.nn.softmax(alphas["normal"], axis=-1)
+        w_reduce = jax.nn.softmax(alphas["reduce"], axis=-1)
+        c_curr = self.stem_multiplier * self.channels
+        s = nn.Conv(c_curr, (3, 3), padding="SAME", use_bias=False)(x)
+        s = nn.BatchNorm(use_running_average=not train, momentum=0.9)(s)
+        s0 = s1 = s
+        c_curr = self.channels
+        reduction_prev = False
+        for layer in range(self.layers):
+            reduction = layer in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                c_curr *= 2
+            cell = SearchCell(self.steps, self.multiplier, c_curr,
+                              reduction, reduction_prev)
+            s0, s1 = s1, cell(s0, s1, w_reduce if reduction else w_normal, train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.output_dim)(out)
+
+
+def init_alphas(rng: jax.Array, steps: int = 4) -> dict:
+    """1e-3 * randn, like model_search.py:232-242."""
+    k = num_edges(steps)
+    k1, k2 = jax.random.split(rng)
+    return {
+        "normal": 1e-3 * jax.random.normal(k1, (k, len(PRIMITIVES))),
+        "reduce": 1e-3 * jax.random.normal(k2, (k, len(PRIMITIVES))),
+    }
+
+
+def derive_genotype(alphas: dict, steps: int = 4, multiplier: int = 4) -> Genotype:
+    """Discretize: per node keep the 2 strongest input edges (ranked by their
+    best non-'none' op weight), each with its best non-'none' op
+    (model_search.py:263-297)."""
+
+    def parse(w: np.ndarray):
+        gene, offset = [], 0
+        for i in range(steps):
+            n_in = 2 + i
+            W = w[offset : offset + n_in]
+            edge_strength = [
+                max(W[j][k] for k in range(len(PRIMITIVES)) if PRIMITIVES[k] != "none")
+                for j in range(n_in)
+            ]
+            top2 = sorted(range(n_in), key=lambda j: -edge_strength[j])[:2]
+            for j in sorted(top2):
+                k_best = max(
+                    (k for k in range(len(PRIMITIVES)) if PRIMITIVES[k] != "none"),
+                    key=lambda k: W[j][k],
+                )
+                gene.append((PRIMITIVES[k_best], j))
+            offset += n_in
+        return gene
+
+    wn = np.asarray(jax.nn.softmax(alphas["normal"], axis=-1))
+    wr = np.asarray(jax.nn.softmax(alphas["reduce"], axis=-1))
+    concat = tuple(range(2 + steps - multiplier, steps + 2))
+    # tuples, not lists: the genotype becomes a static (hashable) attribute
+    # of the discrete flax module
+    return Genotype(tuple(parse(wn)), concat, tuple(parse(wr)), concat)
+
+
+# --------------------------------------------------- discrete (train) network
+
+class _DiscreteOp(nn.Module):
+    op_name: str                # 'name' is reserved by flax
+    channels: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c, s = self.channels, self.stride
+        n = self.op_name
+        if n == "none":
+            return _zero(x, s)
+        if n == "max_pool_3x3":
+            return _max_pool_3x3(x, s)
+        if n == "avg_pool_3x3":
+            return _avg_pool_3x3(x, s)
+        if n == "skip_connect":
+            return x if s == 1 else FactorizedReduce(c)(x, train)
+        if n == "sep_conv_3x3":
+            return SepConv(c, 3, s)(x, train)
+        if n == "sep_conv_5x5":
+            return SepConv(c, 5, s)(x, train)
+        if n == "dil_conv_3x3":
+            return DilConv(c, 3, s, 2)(x, train)
+        if n == "dil_conv_5x5":
+            return DilConv(c, 5, s, 2)(x, train)
+        raise ValueError(f"unknown op {n!r}")
+
+
+class DiscreteCell(nn.Module):
+    genotype_edges: tuple      # ((op_name, input_idx) x 2*steps)
+    concat: tuple
+    channels: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, train: bool = False):
+        c = self.channels
+        if self.reduction_prev:
+            s0 = FactorizedReduce(c)(s0, train)
+        else:
+            s0 = ReLUConvBN(c)(s0, train)
+        s1 = ReLUConvBN(c)(s1, train)
+        states = [s0, s1]
+        steps = len(self.genotype_edges) // 2
+        for i in range(steps):
+            parts = []
+            for (op_name, j) in self.genotype_edges[2 * i : 2 * i + 2]:
+                stride = 2 if self.reduction and j < 2 else 1
+                parts.append(_DiscreteOp(op_name, c, stride)(states[j], train))
+            states.append(sum(parts))
+        return jnp.concatenate([states[i] for i in self.concat], axis=-1)
+
+
+class DartsNetwork(nn.Module):
+    """Discrete network built from a derived genotype (model.py counterpart);
+    used for FedNAS' post-search federated training phase."""
+
+    genotype: Any              # Genotype (hashable tuple-of-tuples form)
+    channels: int = 16
+    layers: int = 8
+    stem_multiplier: int = 3
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        g = self.genotype
+        c_curr = self.stem_multiplier * self.channels
+        s = nn.Conv(c_curr, (3, 3), padding="SAME", use_bias=False)(x)
+        s = nn.BatchNorm(use_running_average=not train, momentum=0.9)(s)
+        s0 = s1 = s
+        c_curr = self.channels
+        reduction_prev = False
+        for layer in range(self.layers):
+            reduction = layer in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                c_curr *= 2
+            edges = tuple(g.reduce) if reduction else tuple(g.normal)
+            concat = tuple(g.reduce_concat) if reduction else tuple(g.normal_concat)
+            cell = DiscreteCell(edges, concat, c_curr, reduction, reduction_prev)
+            s0, s1 = s1, cell(s0, s1, train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.output_dim)(out)

@@ -57,6 +57,20 @@ def test_lcc_roundtrip():
         np.testing.assert_array_equal(rec.reshape(X.shape), X)
 
 
+def test_decode_rejects_insufficient_shares():
+    """Below-threshold reconstruction must fail loudly, not return garbage."""
+    import pytest
+
+    rng = np.random.default_rng(7)
+    X = rng.integers(0, int(P_DEFAULT), size=(4, 4), dtype=np.int64)
+    enc = lcc_encode(X, 8, K=2, T=2, rng=rng)
+    with pytest.raises(ValueError):
+        lcc_decode(enc[[0, 1, 2]], 8, 2, 2, [0, 1, 2])  # 3 < K+T=4
+    shares = bgw_encode(X, 5, T=2, rng=rng)
+    with pytest.raises(ValueError):
+        bgw_decode(shares[[0, 1, 2]], [0, 1])  # share/index mismatch
+
+
 def test_lcc_points_disjoint():
     """Privacy precondition: no worker may be evaluated at a data beta, or
     it receives a raw secret chunk (reference defect fixed, not replicated)."""
