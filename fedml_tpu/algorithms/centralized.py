@@ -47,7 +47,7 @@ class CentralizedTrainer:
         self.bundle = bundle or create_model(
             config.model, dataset.class_num, input_shape=dataset.train_x.shape[2:] or None
         )
-        self.task = get_task(dataset.task)
+        self.task = get_task(dataset.task, dataset.class_num)
         self.root_key = seed_everything(config.seed)
         self.variables = self.bundle.init(self.root_key)
         self.x, self.y, self.mask = merge_clients(dataset, config.batch_size)

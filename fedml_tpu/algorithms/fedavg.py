@@ -54,7 +54,7 @@ class FedAvgAPI:
             config.model, dataset.class_num,
             input_shape=dataset.train_x.shape[2:] or None,
         )
-        self.task = get_task(dataset.task)
+        self.task = get_task(dataset.task, dataset.class_num)
         self.root_key = seed_everything(config.seed)
         self.variables = self.bundle.init(self.root_key)
         self._local_train = self.build_local_train()

@@ -1,0 +1,40 @@
+"""FedSeg — federated semantic segmentation.
+
+Counterpart of reference fedml_api/distributed/fedseg/ (FedSegAggregator.py:
+12-190): FedAvg weight aggregation over a segmentation model, with the
+Evaluator's confusion-matrix metrics (Acc / Acc_class / mIoU / FWIoU,
+utils.py:246-283) tracked per round in an EvaluationMetricsKeeper-style
+history (utils.py:62-70).
+
+The round loop, vmapped local trainer, and psum aggregation are inherited
+from FedAvgAPI — the segmentation task's loss/metrics (core/tasks.py)
+carry the confusion matrix through the same jitted eval scan, so the only
+specialization here is score finalization."""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.tasks import segmentation_scores
+
+log = logging.getLogger(__name__)
+
+
+class FedSegAPI(FedAvgAPI):
+    """Standalone-simulation federated segmentation."""
+
+    def evaluate_global(self) -> dict:
+        sums = jax.device_get(self._eval(
+            self.variables, self.dataset.test_x, self.dataset.test_y,
+            self.dataset.test_mask,
+        ))
+        scores = {k: float(v) for k, v in segmentation_scores(sums["confusion"]).items()}
+        # FedAvgAPI.train logs 'acc'/'loss'; map pixel-acc and mIoU onto them
+        scores["acc"] = scores["Acc"]
+        scores["loss"] = 1.0 - scores["mIoU"]
+        scores["confusion_total"] = float(np.sum(np.asarray(sums["confusion"])))
+        return scores

@@ -119,7 +119,7 @@ class SplitNNAPI:
         self.config = config
         self.client_bundle = client_bundle
         self.server_bundle = server_bundle
-        self.task = get_task(dataset.task)
+        self.task = get_task(dataset.task, dataset.class_num)
         self.root_key = seed_everything(config.seed)
 
         # reference optimizers: SGD lr .1 momentum .9 wd 5e-4 for BOTH stages

@@ -13,7 +13,7 @@ carry mask 0 and contribute nothing to loss or metrics.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -144,30 +144,36 @@ def make_segmentation_task(num_classes: int, ignore_index: int = 255) -> Task:
         pred = jnp.argmax(logits, axis=-1)
         tgt = jnp.where(valid, targets, 0)
         idx = tgt * num_classes + pred
+        # int32 accumulation: float32 stalls at 2^24, which a single large
+        # eval pool's background cell can exceed; int32 is exact to 2.1e9
         conf = jnp.bincount(
-            idx.reshape(-1), weights=valid.reshape(-1).astype(jnp.float32),
+            idx.reshape(-1), weights=valid.reshape(-1).astype(jnp.int32),
             length=num_classes * num_classes,
         ).reshape(num_classes, num_classes)
-        return {"confusion": conf, "count": jnp.sum(valid.astype(jnp.float32))}
+        return {"confusion": conf, "count": jnp.sum(valid.astype(jnp.int32))}
 
     return Task(seg_loss, seg_metrics)
 
 
-def segmentation_scores(confusion: jax.Array) -> dict:
+def segmentation_scores(confusion) -> dict:
     """Derive Acc / Acc_class / mIoU / FWIoU from an accumulated confusion
-    matrix (reference Evaluator in fedseg/utils.py)."""
-    conf = jnp.asarray(confusion, jnp.float64)
-    total = jnp.maximum(jnp.sum(conf), 1.0)
-    diag = jnp.diag(conf)
-    rows = jnp.sum(conf, axis=1)
-    cols = jnp.sum(conf, axis=0)
-    acc = jnp.sum(diag) / total
-    acc_class = jnp.nanmean(jnp.where(rows > 0, diag / jnp.maximum(rows, 1.0), jnp.nan))
-    union = rows + cols - diag
-    iou = jnp.where(union > 0, diag / jnp.maximum(union, 1.0), jnp.nan)
-    miou = jnp.nanmean(iou)
+    matrix (reference Evaluator in fedseg/utils.py). Host-side finalizer:
+    numpy float64, since jnp silently truncates to f32 without x64 mode."""
+    import numpy as np
+
+    conf = np.asarray(confusion, np.float64)
+    total = max(conf.sum(), 1.0)
+    diag = np.diag(conf)
+    rows = conf.sum(axis=1)
+    cols = conf.sum(axis=0)
+    acc = diag.sum() / total
+    with np.errstate(invalid="ignore"):
+        acc_class = np.nanmean(np.where(rows > 0, diag / np.maximum(rows, 1.0), np.nan))
+        union = rows + cols - diag
+        iou = np.where(union > 0, diag / np.maximum(union, 1.0), np.nan)
+        miou = np.nanmean(iou)
     freq = rows / total
-    fwiou = jnp.nansum(jnp.where(union > 0, freq * diag / jnp.maximum(union, 1.0), 0.0))
+    fwiou = np.nansum(np.where(union > 0, freq * diag / np.maximum(union, 1.0), 0.0))
     return {"Acc": acc, "Acc_class": acc_class, "mIoU": miou, "FWIoU": fwiou}
 
 
@@ -178,7 +184,13 @@ TASKS: dict[str, Task] = {
 }
 
 
-def get_task(name: str) -> Task:
+def get_task(name: str, class_num: Optional[int] = None) -> Task:
+    """'segmentation' is parameterized by class count (its metrics carry a
+    [C, C] confusion matrix), so it is built on demand rather than looked up."""
+    if name == "segmentation":
+        if not class_num:
+            raise ValueError("segmentation task requires class_num")
+        return make_segmentation_task(class_num)
     if name not in TASKS:
-        raise KeyError(f"unknown task {name!r}; known: {sorted(TASKS)}")
+        raise KeyError(f"unknown task {name!r}; known: {sorted(TASKS) + ['segmentation']}")
     return TASKS[name]

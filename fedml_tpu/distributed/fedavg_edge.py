@@ -60,7 +60,7 @@ class FedAVGAggregator:
         self.sample_num_dict: dict[int, float] = {}
         self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}
         self.test_history: list[dict] = []
-        self._eval = make_eval_fn(bundle, get_task(dataset.task)) if bundle is not None and dataset is not None else None
+        self._eval = make_eval_fn(bundle, get_task(dataset.task, dataset.class_num)) if bundle is not None and dataset is not None else None
 
     def get_global_model_params(self):
         return self.variables
@@ -174,7 +174,7 @@ class FedAVGTrainer:
         self.config = config
         self.local_train = jax.jit(
             make_local_train_fn(
-                bundle, get_task(dataset.task),
+                bundle, get_task(dataset.task, dataset.class_num),
                 optimizer=config.client_optimizer, lr=config.lr,
                 momentum=config.momentum, wd=config.wd,
                 epochs=config.epochs, batch_size=config.batch_size,

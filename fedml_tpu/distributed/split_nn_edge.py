@@ -299,7 +299,7 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
     Returns the server trainer (val_history, final variables)."""
     from fedml_tpu.core.rng import seed_everything
 
-    task = get_task(dataset.task)
+    task = get_task(dataset.task, dataset.class_num)
     n_clients = dataset.num_clients
     size = n_clients + 1
     root = seed_everything(config.seed)

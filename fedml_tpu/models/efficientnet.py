@@ -1,0 +1,158 @@
+"""EfficientNet-B0..B7 in flax.
+
+Counterpart of reference fedml_api/model/cv/efficientnet.py +
+efficientnet_utils.py (MBConv blocks with expansion, squeeze-excite, swish,
+stochastic depth, compound width/depth scaling).
+
+TPU notes: NHWC, bf16-friendly; drop-path (stochastic depth) uses the flax
+'dropout' rng collection; batch-norm momentum 0.99 like the original recipe.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+
+# (expand_ratio, channels, repeats, stride, kernel) — the B0 backbone
+_B0_BLOCKS = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+# width_mult, depth_mult, resolution, dropout
+_SCALING = {
+    "b0": (1.0, 1.0, 224, 0.2),
+    "b1": (1.0, 1.1, 240, 0.2),
+    "b2": (1.1, 1.2, 260, 0.3),
+    "b3": (1.2, 1.4, 300, 0.3),
+    "b4": (1.4, 1.8, 380, 0.4),
+    "b5": (1.6, 2.2, 456, 0.4),
+    "b6": (1.8, 2.6, 528, 0.5),
+    "b7": (2.0, 3.1, 600, 0.5),
+}
+
+
+def _round_filters(filters: float, width_mult: float, divisor: int = 8) -> int:
+    f = filters * width_mult
+    new = max(divisor, int(f + divisor / 2) // divisor * divisor)
+    if new < 0.9 * f:
+        new += divisor
+    return int(new)
+
+
+def _round_repeats(repeats: int, depth_mult: float) -> int:
+    return int(math.ceil(repeats * depth_mult))
+
+
+class SqueezeExcite(nn.Module):
+    reduced: int
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(self.reduced, (1, 1))(s)
+        s = nn.swish(s)
+        s = nn.Conv(x.shape[-1], (1, 1))(s)
+        return x * jax.nn.sigmoid(s)
+
+
+class MBConv(nn.Module):
+    c_out: int
+    expand: int
+    stride: int
+    kernel: int
+    drop_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c_in = x.shape[-1]
+        norm = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.99, dtype=self.dtype
+        )
+        y = x
+        if self.expand != 1:
+            y = nn.Conv(c_in * self.expand, (1, 1), use_bias=False, dtype=self.dtype)(y)
+            y = nn.swish(norm()(y))
+        y = nn.Conv(
+            y.shape[-1], (self.kernel, self.kernel),
+            strides=(self.stride, self.stride), padding="SAME",
+            feature_group_count=y.shape[-1], use_bias=False, dtype=self.dtype,
+        )(y)
+        y = nn.swish(norm()(y))
+        y = SqueezeExcite(max(1, c_in // 4))(y)
+        y = nn.Conv(self.c_out, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm()(y)
+        if self.stride == 1 and c_in == self.c_out:
+            if train and self.drop_rate > 0:
+                # stochastic depth: drop the whole residual branch per sample
+                keep = 1.0 - self.drop_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(rng, keep, (y.shape[0], 1, 1, 1))
+                y = jnp.where(mask, y / keep, 0.0)
+            y = y + x
+        return y
+
+
+class EfficientNet(nn.Module):
+    variant: str = "b0"
+    output_dim: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        width, depth, _, dropout = _SCALING[self.variant]
+        x = x.astype(self.dtype)
+        norm = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.99, dtype=self.dtype
+        )
+        x = nn.Conv(_round_filters(32, width), (3, 3), strides=(2, 2),
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.swish(norm()(x))
+        total_blocks = sum(_round_repeats(r, depth) for _, _, r, _, _ in _B0_BLOCKS)
+        block_idx = 0
+        for expand, c, repeats, stride, kernel in _B0_BLOCKS:
+            c_out = _round_filters(c, width)
+            for i in range(_round_repeats(repeats, depth)):
+                # linearly increasing stochastic depth, survival 0.8 at the top
+                drop = 0.2 * block_idx / max(total_blocks, 1)
+                x = MBConv(
+                    c_out, expand, stride if i == 0 else 1, kernel,
+                    drop_rate=drop, dtype=self.dtype,
+                )(x, train=train)
+                block_idx += 1
+        x = nn.Conv(_round_filters(1280, width), (1, 1), use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.swish(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        if train and dropout > 0:
+            x = nn.Dropout(dropout, deterministic=False)(x)
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def _make(variant: str):
+    @register_model(f"efficientnet-{variant}")
+    def _f(output_dim: int, input_shape=(32, 32, 3), dtype=jnp.float32, **_):
+        return ModelBundle(
+            name=f"efficientnet-{variant}",
+            module=EfficientNet(variant, output_dim, dtype=dtype),
+            input_shape=tuple(input_shape),
+            has_batch_stats=True,
+            uses_dropout=True,
+        )
+    return _f
+
+
+for _v in _SCALING:
+    _make(_v)

@@ -214,8 +214,16 @@ def make_eval_fn(bundle: ModelBundle, task: Task, eval_batch_size: int = 256):
 
 
 def finalize_metrics(sums: dict) -> dict:
-    """Metric sums -> human metrics (acc, loss, precision/recall)."""
+    """Metric sums -> human metrics (acc, loss, precision/recall; for
+    segmentation sums, Acc/mIoU/FWIoU via the confusion matrix)."""
     out = {}
+    if "confusion" in sums:
+        from fedml_tpu.core.tasks import segmentation_scores
+
+        scores = {k: float(v) for k, v in segmentation_scores(sums["confusion"]).items()}
+        scores["acc"] = scores["Acc"]
+        scores["loss"] = 1.0 - scores["mIoU"]
+        return scores
     count = float(sums.get("count", 1.0))
     if "correct" in sums:
         out["acc"] = float(sums["correct"]) / max(count, 1.0)

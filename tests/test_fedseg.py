@@ -1,0 +1,80 @@
+"""FedSeg tests (reference distributed/fedseg/).
+
+- segmentation task: confusion-matrix math matches a numpy oracle,
+  ignore-index pixels excluded,
+- segmentation_scores reproduces the Evaluator formulas,
+- seg models produce per-pixel logits at input resolution,
+- a tiny federated segmentation run learns above chance mIoU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedseg import FedSegAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.tasks import make_segmentation_task, segmentation_scores
+from fedml_tpu.data.segmentation import make_synthetic_segmentation
+from fedml_tpu.models import create_model
+
+
+def test_confusion_matrix_vs_numpy_oracle():
+    task = make_segmentation_task(3)
+    rng = np.random.default_rng(0)
+    logits = rng.normal(0, 1, (2, 4, 4, 3)).astype(np.float32)
+    targets = rng.integers(0, 3, (2, 4, 4)).astype(np.int32)
+    targets[0, 0, 0] = 255              # ignored
+    mask = np.array([1.0, 1.0], np.float32)
+    m = task.metrics(jnp.asarray(logits), jnp.asarray(targets), jnp.asarray(mask))
+    conf = np.asarray(m["confusion"])
+    pred = logits.argmax(-1)
+    oracle = np.zeros((3, 3))
+    for b in range(2):
+        for i in range(4):
+            for j in range(4):
+                if targets[b, i, j] != 255:
+                    oracle[targets[b, i, j], pred[b, i, j]] += 1
+    np.testing.assert_array_equal(conf, oracle)
+    assert float(m["count"]) == 31      # 32 pixels - 1 ignored
+
+    # record-level mask drops the whole image
+    m2 = task.metrics(jnp.asarray(logits), jnp.asarray(targets),
+                      jnp.asarray([1.0, 0.0]))
+    assert float(m2["count"]) == 15
+
+
+def test_segmentation_scores_formulas():
+    conf = np.array([[50, 0, 0], [0, 30, 10], [0, 10, 0]], np.float64)
+    s = {k: float(v) for k, v in segmentation_scores(conf).items()}
+    assert abs(s["Acc"] - 80 / 100) < 1e-9
+    # IoU: c0 = 50/50, c1 = 30/50, c2 = 0/20
+    assert abs(s["mIoU"] - np.mean([1.0, 0.6, 0.0])) < 1e-6
+    fwiou = (50 / 100) * 1.0 + (40 / 100) * 0.6 + (10 / 100) * 0.0
+    assert abs(s["FWIoU"] - fwiou) < 1e-6
+
+
+def test_seg_models_output_resolution():
+    for name in ("deeplab_lite", "unet"):
+        b = create_model(name, 4, input_shape=(16, 16, 3))
+        v = b.init(jax.random.PRNGKey(0))
+        out = b.apply_eval(v, jnp.zeros((2, 16, 16, 3)))
+        assert out.shape == (2, 16, 16, 4), name
+
+
+def test_fedseg_learns():
+    ds = make_synthetic_segmentation(
+        num_clients=4, records_per_client=8, image_size=16,
+        num_classes=3, batch_size=4, seed=0,
+    )
+    cfg = FedConfig(
+        model="unet", dataset="synthetic_seg", client_num_in_total=4,
+        client_num_per_round=4, comm_round=10, epochs=2, batch_size=4,
+        lr=0.1, momentum=0.9, seed=1, frequency_of_the_test=5,
+    )
+    api = FedSegAPI(ds, cfg, create_model("unet", 3, input_shape=(16, 16, 3)))
+    hist = api.train()
+    scores = api.evaluate_global()
+    # mIoU rules out the predict-background-everywhere degenerate solution
+    # (which scores ~0.26 here); a learning model clears 0.4 easily
+    assert scores["mIoU"] > 0.4, scores
+    assert hist["Test/Acc"][-1] > 0.7
