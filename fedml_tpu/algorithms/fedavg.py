@@ -17,7 +17,6 @@ sample-weight-averages the results. Differences by design:
 from __future__ import annotations
 
 import logging
-import time
 from functools import partial
 from typing import Callable, Optional
 
@@ -126,18 +125,30 @@ class FedAvgAPI:
         return finalize_metrics(jax.tree.map(np.asarray, sums))
 
     def train(self) -> dict:
+        from fedml_tpu.utils.metrics import MetricsLogger, RoundTimer
+
         c = self.config
-        t0 = time.time()
+        timer = RoundTimer()
+        logger = MetricsLogger(c.run_name, c.enable_wandb, config=c.to_dict())
         for r in range(c.comm_round):
-            loss = self.run_round(r)
+            with timer.phase("train"):
+                loss = self.run_round(r)
+            timer.tick_round()
             if r % c.frequency_of_the_test == 0 or r == c.comm_round - 1:
-                m = self.evaluate_global()
+                with timer.phase("eval"):
+                    m = self.evaluate_global()
                 self.history["round"].append(r)
                 self.history["Test/Acc"].append(m.get("acc"))
                 self.history["Test/Loss"].append(m.get("loss"))
-                log.info("round %d train_loss %.4f test %s", r, loss, m)
-        dt = time.time() - t0
-        self.history["rounds_per_sec"] = c.comm_round / dt
+                logger.log(
+                    {"Train/Loss": loss, "Test/Acc": m.get("acc"),
+                     "Test/Loss": m.get("loss")}, r,
+                )
+        timing = timer.summary()
+        self.history["rounds_per_sec"] = timing["rounds_per_sec"]
+        self.history["timing"] = timing
+        self.metrics_logger = logger
+        logger.close()
         return self.history
 
 

@@ -81,7 +81,7 @@ def load_dataset(name: str, **kw) -> FedDataset:
     shakespeare, fed_shakespeare, fed_cifar100, stackoverflow_lr,
     stackoverflow_nwp, cifar10, cifar100, cinic10, synthetic_1_1, ...)."""
     from fedml_tpu.data import (  # noqa: F401
-        cifar, femnist, mnist, segmentation, shakespeare, stackoverflow, synthetic,
+        cifar, femnist, imagenet, mnist, segmentation, shakespeare, stackoverflow, synthetic,
     )
     if name not in _LOADERS:
         raise KeyError(f"unknown dataset {name!r}; known: {sorted(_LOADERS)}")
@@ -90,6 +90,6 @@ def load_dataset(name: str, **kw) -> FedDataset:
 
 def known_datasets() -> list[str]:
     from fedml_tpu.data import (  # noqa: F401
-        cifar, femnist, mnist, segmentation, shakespeare, stackoverflow, synthetic,
+        cifar, femnist, imagenet, mnist, segmentation, shakespeare, stackoverflow, synthetic,
     )
     return sorted(_LOADERS)

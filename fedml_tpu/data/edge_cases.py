@@ -1,0 +1,122 @@
+"""Edge-case backdoor datasets (poisoned federations).
+
+Counterpart of reference fedml_api/data_preprocessing/edge_case_examples/
+data_loader.py:283 ``load_poisoned_dataset``: attacker clients' training
+data is augmented with "edge-case" examples — real-looking inputs from a
+rare tail distribution relabeled to the attacker's target class (southwest
+airliners -> 'truck' in CIFAR-10, ARDIS digits -> '7' in EMNIST) — plus a
+backdoor test set to measure targeted success.
+
+Real poison archives are file-gated (zero egress); the fallback synthesizes
+an off-manifold edge cluster: inputs drawn far from every class mean,
+labeled with the target class. This preserves the measurement the
+reference's datasets exist for — clean accuracy vs targeted backdoor
+accuracy — with no downloaded data.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass
+
+import numpy as np
+
+from fedml_tpu.data import FedDataset
+
+
+@dataclass
+class PoisonedFederation:
+    dataset: FedDataset            # train data with attacker clients poisoned
+    attacker_clients: list         # indices of poisoned clients
+    target_class: int
+    edge_test_x: np.ndarray        # backdoor eval inputs
+    edge_test_y: np.ndarray        # all == target_class
+    edge_test_true_y: np.ndarray   # what they SHOULD be classified as
+
+
+def _synthesize_edge_cases(
+    base: FedDataset, n: int, target_class: int, rng: np.random.Generator
+) -> tuple[np.ndarray, np.ndarray]:
+    """Off-manifold cluster: base-distribution shape, shifted far from the
+    data mean with a fixed pattern so the backdoor is learnable."""
+    shape = (n,) + tuple(base.train_x.shape[2:])
+    x = rng.normal(0, 0.3, shape).astype(base.train_x.dtype)
+    # fixed structured offset = the 'edge-case signature'
+    sig = np.linspace(-1.5, 1.5, int(np.prod(shape[1:]))).reshape(shape[1:])
+    x = x + sig.astype(x.dtype)
+    y_true = rng.integers(0, base.class_num, n).astype(base.train_y.dtype)
+    return x, y_true
+
+
+def load_poisoned_dataset(
+    base: FedDataset,
+    attack_case: str = "edge-case",
+    target_class: int = 1,
+    attacker_clients: list | None = None,
+    poison_frac: float = 0.5,
+    data_dir: str = "./data",
+    seed: int = 0,
+) -> PoisonedFederation:
+    """Inject edge-case poison into `attacker_clients` (default: client 1,
+    like the reference's rank-1 attacker, FedAvgRobustTrainer.py:14-25).
+
+    With real archives ({data_dir}/edge_case_examples/southwest.pkl, etc.)
+    the genuine edge images are used; otherwise the synthetic edge cluster.
+    ``poison_frac`` of each attacker's padded slots are replaced.
+    """
+    rng = np.random.default_rng(seed)
+    attacker_clients = attacker_clients if attacker_clients is not None else [1]
+    path = os.path.join(data_dir, "edge_case_examples", f"{attack_case.replace('-', '_')}.pkl")
+    n_pad = base.train_x.shape[1]
+    n_poison_per = max(int(n_pad * poison_frac), 1)
+
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        edge_x = np.asarray(blob["x"], base.train_x.dtype)
+        edge_true = np.asarray(blob.get("y_true", np.zeros(len(edge_x))), base.train_y.dtype)
+    else:
+        edge_x, edge_true = _synthesize_edge_cases(
+            base, n_poison_per * (len(attacker_clients) + 4), target_class, rng
+        )
+
+    train_x = base.train_x.copy()
+    train_y = base.train_y.copy()
+    used = 0
+    for c in attacker_clients:
+        # poison REPLACES real records (slots within the client's true
+        # count), preserving the mask/count invariant the local trainer
+        # relies on — padded slots never train, so flipping their mask
+        # would silently shrink the effective poison
+        n_real = int(base.train_counts[c])
+        take = min(n_poison_per, len(edge_x) - used, n_real)
+        slots = rng.choice(n_real, take, replace=False)
+        train_x[c, slots] = edge_x[used : used + take]
+        train_y[c, slots] = target_class
+        used += take
+
+    # remaining edge cases form the backdoor test set
+    edge_test_x = edge_x[used:]
+    edge_test_true = edge_true[used:]
+    import dataclasses
+
+    poisoned = dataclasses.replace(
+        base, train_x=train_x, train_y=train_y,
+        name=f"{base.name}+{attack_case}",
+    )
+    return PoisonedFederation(
+        dataset=poisoned,
+        attacker_clients=list(attacker_clients),
+        target_class=target_class,
+        edge_test_x=edge_test_x,
+        edge_test_y=np.full(len(edge_test_x), target_class, base.train_y.dtype),
+        edge_test_true_y=edge_test_true,
+    )
+
+
+def backdoor_success_rate(logits: np.ndarray, target_class: int) -> float:
+    """Fraction of edge-case inputs classified as the attacker's target."""
+    if len(logits) == 0:
+        return 0.0
+    return float((np.argmax(logits, axis=-1) == target_class).mean())

@@ -1,0 +1,128 @@
+"""ImageNet (ILSVRC2012) + Google Landmarks federated loaders.
+
+Counterparts of reference fedml_api/data_preprocessing/ImageNet/data_loader.py
+(folder-per-class layout, equal client split) and Landmarks/data_loader.py
+(csv mapping rows (user_id, image_id, class) onto an image folder — natural
+233/1,262-client federation for gld23k/gld160k).
+
+Real images are absent in this zero-egress environment; the loaders are
+file-gated and otherwise fall back to a learnable synthetic stand-in of the
+same shape contract ([H, W, 3] float32, int labels), so every code path
+downstream of the loader is identical either way.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from fedml_tpu.data import FedDataset, register_dataset
+from fedml_tpu.data.batching import pad_and_stack_clients, pad_eval_pool
+from fedml_tpu.data.synthetic import make_synthetic_classification
+
+
+def _read_image(path: str, size: int) -> np.ndarray:
+    from PIL import Image
+
+    im = Image.open(path).convert("RGB").resize((size, size))
+    return np.asarray(im, np.float32) / 255.0
+
+
+@register_dataset("ILSVRC2012", "imagenet")
+def load_imagenet(
+    data_dir: str = "./data", num_clients: int = 10, batch_size: int = 32,
+    image_size: int = 64, max_per_class: int = 50, seed: int = 0, **_,
+) -> FedDataset:
+    """Folder layout {data_dir}/ILSVRC2012/train/<wnid>/*.JPEG; clients get
+    an equal random split (reference ImageNet/data_loader.py uses an equal
+    partition over the sample index space)."""
+    root = os.path.join(data_dir, "ILSVRC2012", "train")
+    if not os.path.isdir(root):
+        return make_synthetic_classification(
+            "imagenet", (image_size, image_size, 3), 100, num_clients,
+            records_per_client=32, partition_method="homo",
+            batch_size=batch_size, seed=seed,
+        )
+    classes = sorted(os.listdir(root))
+    xs_all, ys_all = [], []
+    for ci, wnid in enumerate(classes):
+        files = sorted(os.listdir(os.path.join(root, wnid)))[:max_per_class]
+        for f in files:
+            xs_all.append(_read_image(os.path.join(root, wnid, f), image_size))
+            ys_all.append(ci)
+    x = np.stack(xs_all)
+    y = np.asarray(ys_all, np.int32)
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(x))
+    n_test = max(len(x) // 10, 1)
+    te, tr = order[:n_test], order[n_test:]
+    splits = np.array_split(tr, num_clients)
+    tx, ty, tm, tc = pad_and_stack_clients(
+        [x[s] for s in splits], [y[s] for s in splits], batch_size
+    )
+    ex, ey, em = pad_eval_pool(x[te], y[te], 64)
+    return FedDataset(
+        train_x=tx, train_y=ty, train_mask=tm, train_counts=tc,
+        test_x=ex, test_y=ey, test_mask=em,
+        class_num=len(classes), name="ILSVRC2012",
+    )
+
+
+def load_landmarks(
+    data_dir: str = "./data", num_clients: int = 16, batch_size: int = 16,
+    image_size: int = 64, seed: int = 0, variant: str = "gld23k", **_,
+) -> FedDataset:
+    """CSV schema user_id,image_id,class (reference Landmarks/data_loader.py):
+    the user_id column IS the federation — clients are given, not
+    partitioned."""
+    csv_path = os.path.join(data_dir, "landmarks", f"{variant}_train.csv")
+    img_root = os.path.join(data_dir, "landmarks", "images")
+    if not (os.path.exists(csv_path) and os.path.isdir(img_root)):
+        return make_synthetic_classification(
+            variant, (image_size, image_size, 3), 40, num_clients,
+            records_per_client=24, partition_method="hetero",
+            batch_size=batch_size, seed=seed,
+        )
+    by_user: dict[str, list] = {}
+    classes: set = set()
+    with open(csv_path) as f:
+        for row in csv.DictReader(f):
+            by_user.setdefault(row["user_id"], []).append(
+                (row["image_id"], int(row["class"]))
+            )
+            classes.add(int(row["class"]))
+    users = sorted(by_user)[:num_clients]
+    xs, ys, test_x, test_y = [], [], [], []
+    for u in users:
+        recs = by_user[u]
+        imgs = np.stack([
+            _read_image(os.path.join(img_root, f"{iid}.jpg"), image_size)
+            for iid, _ in recs
+        ])
+        labels = np.asarray([c for _, c in recs], np.int32)
+        n_hold = max(len(recs) // 10, 1)
+        xs.append(imgs[n_hold:]); ys.append(labels[n_hold:])
+        test_x.append(imgs[:n_hold]); test_y.append(labels[:n_hold])
+    tx, ty, tm, tc = pad_and_stack_clients(xs, ys, batch_size)
+    ex, ey, em = pad_eval_pool(np.concatenate(test_x), np.concatenate(test_y), 64)
+    return FedDataset(
+        train_x=tx, train_y=ty, train_mask=tm, train_counts=tc,
+        test_x=ex, test_y=ey, test_mask=em,
+        class_num=max(classes) + 1, name=variant,
+    )
+
+
+# registry dispatch doesn't forward the requested name, so each variant
+# gets its own registered wrapper pinning `variant`
+@register_dataset("gld23k")
+def _gld23k(**kw) -> FedDataset:
+    kw.pop("variant", None)
+    return load_landmarks(variant="gld23k", **kw)
+
+
+@register_dataset("gld160k")
+def _gld160k(**kw) -> FedDataset:
+    kw.pop("variant", None)
+    return load_landmarks(variant="gld160k", **kw)

@@ -104,6 +104,30 @@ def load_lending_club(
     )
 
 
+def load_uci_credit(
+    data_dir: str, test_frac: float = 0.2, seed: int = 0
+) -> VerticalDataset:
+    """UCI default-of-credit-card-clients two-party split (reference
+    UCI/ loader): party A = demographic columns, party B = bill/payment
+    history. Expects ``uci_credit.npz`` with X [n, 23], y; synthetic
+    fallback keeps those widths (A=5 demographics, B=18 history)."""
+    path = os.path.join(data_dir, "UCI", "uci_credit.npz")
+    if not os.path.exists(path):
+        return make_synthetic_vertical((5, 18), seed=seed, name="uci_credit_synth")
+    blob = np.load(path)
+    X, y = _standardize(blob["X"]), blob["y"].astype(np.float32)
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(y))
+    n_test = int(len(y) * test_frac)
+    tr, te = order[n_test:], order[:n_test]
+    parts = [X[:, :5], X[:, 5:]]
+    return VerticalDataset(
+        train_parts=[p[tr] for p in parts], train_y=y[tr],
+        test_parts=[p[te] for p in parts], test_y=y[te],
+        name="uci_credit",
+    )
+
+
 def load_nus_wide(
     data_dir: str, selected_label: str = "sky", test_frac: float = 0.2, seed: int = 0
 ) -> VerticalDataset:

@@ -1,0 +1,44 @@
+"""Unified experiment launcher (fed_launch counterpart).
+
+``python -m fedml_tpu.experiments.run --algorithm fedavg --dataset mnist
+--model lr --comm_round 20`` — flags mirror the reference mains
+(main_fedavg.py:48-120) via the FedConfig argparse bridge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import Optional, Sequence
+
+from fedml_tpu.core.config import add_args, config_from_args
+from fedml_tpu.experiments import ALGORITHMS, run_experiment
+
+
+def main(argv: Optional[Sequence[str]] = None, default_algorithm: str = "fedavg") -> dict:
+    parser = add_args()
+    parser.add_argument("--algorithm", type=str, default=default_algorithm,
+                        choices=sorted(ALGORITHMS))
+    ns = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(filename)s[line:%(lineno)d] %(levelname)s %(message)s",
+    )
+    algorithm = ns.algorithm
+    del ns.algorithm
+    cfg = config_from_args(ns)
+    result = run_experiment(cfg, algorithm)
+    printable = {}
+    for k, v in dict(result).items():
+        if isinstance(v, list) and v and isinstance(v[-1], (int, float)):
+            printable[k] = v[-1]          # history series -> final value
+        elif isinstance(v, (int, float, str)):
+            printable[k] = v
+    print(json.dumps({"algorithm": algorithm, **printable}))
+    return result
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,126 @@
+"""Observability: round timing, metric logging, profiler hooks.
+
+The reference's observability is wandb-everywhere (init on rank 0,
+main_fedavg.py:300-308; Train/Acc, Train/Loss, Test/Acc, Test/Loss keyed by
+round, fedavg_api.py:173-179) plus wall-clock pairs around aggregation
+(FedAVGAggregator.py:59,85-86) and setproctitle. SURVEY.md §5.1 asks the
+TPU build to make per-round timing and rounds/sec FIRST-CLASS, and to hook
+the jax profiler.
+
+This module provides:
+- :class:`RoundTimer` — per-phase wall-clock sums (train/aggregate/eval) and
+  rounds/sec, cheap enough to always run,
+- :class:`MetricsLogger` — wandb-compatible metric names; logs to an
+  in-memory history + optional JSONL file + optional wandb (import-gated:
+  this environment has no wandb and no egress),
+- :func:`profile_trace` — context manager around ``jax.profiler.trace`` for
+  TensorBoard-consumable device traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from collections import defaultdict
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class RoundTimer:
+    """Accumulates per-phase seconds; `with timer.phase("train"): ...`."""
+
+    def __init__(self):
+        self.sums: dict[str, float] = defaultdict(float)
+        self.rounds = 0
+        self._start = time.time()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.sums[name] += time.perf_counter() - t0
+
+    def tick_round(self):
+        self.rounds += 1
+
+    def summary(self) -> dict:
+        wall = max(time.time() - self._start, 1e-9)
+        out = {f"time/{k}_s": round(v, 4) for k, v in self.sums.items()}
+        out["time/wall_s"] = round(wall, 4)
+        out["rounds_per_sec"] = round(self.rounds / wall, 4) if self.rounds else 0.0
+        return out
+
+
+class MetricsLogger:
+    """wandb-compatible logger with gated backends.
+
+    Names follow the reference exactly ('Train/Acc', 'Test/Acc', 'Test/Loss'
+    keyed by 'round', fedavg_api.py:173-179; per-client 'Client.<id>' and
+    'GLOBAL' in the silo fork, silo_fedavg.py:126-127)."""
+
+    def __init__(
+        self,
+        run_name: str = "fedml_tpu",
+        enable_wandb: bool = False,
+        jsonl_path: Optional[str] = None,
+        config: Optional[dict] = None,
+    ):
+        self.history: list[dict] = []
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._wandb = None
+        if enable_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(project=run_name, config=config or {})
+            except ImportError:
+                log.warning("wandb requested but not installed; logging locally only")
+
+    def log(self, metrics: dict, round_idx: Optional[int] = None):
+        rec = dict(metrics)
+        if round_idx is not None:
+            rec["round"] = round_idx
+        self.history.append(rec)
+        if self._jsonl:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        if self._wandb:
+            self._wandb.log(rec)
+        log.info("metrics %s", rec)
+
+    def last(self, key: str):
+        for rec in reversed(self.history):
+            if key in rec:
+                return rec[key]
+        return None
+
+    def series(self, key: str) -> list:
+        return [r[key] for r in self.history if key in r]
+
+    def close(self):
+        if self._jsonl:
+            self._jsonl.close()
+        if self._wandb:
+            self._wandb.finish()
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: Optional[str]):
+    """Wrap a region in a jax profiler trace (TensorBoard format). No-op
+    when logdir is falsy, so call sites need no gating."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
