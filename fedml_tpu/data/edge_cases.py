@@ -45,8 +45,12 @@ def _synthesize_edge_cases(
     # fixed structured offset = the 'edge-case signature'
     sig = np.linspace(-1.5, 1.5, int(np.prod(shape[1:]))).reshape(shape[1:])
     x = x + sig.astype(x.dtype)
-    y_true = rng.integers(0, base.class_num, n).astype(base.train_y.dtype)
-    return x, y_true
+    # true labels deliberately exclude the attack target so targeted-accuracy
+    # eval (robust.py backdoor metrics) measures real label flips
+    y_true = rng.integers(0, max(base.class_num - 1, 1), n)
+    y_true = np.where(y_true >= target_class, y_true + 1, y_true) \
+        if base.class_num > 1 else y_true
+    return x, y_true.astype(base.train_y.dtype)
 
 
 def load_poisoned_dataset(
@@ -69,7 +73,8 @@ def load_poisoned_dataset(
     attacker_clients = attacker_clients if attacker_clients is not None else [1]
     path = os.path.join(data_dir, "edge_case_examples", f"{attack_case.replace('-', '_')}.pkl")
     n_pad = base.train_x.shape[1]
-    n_poison_per = max(int(n_pad * poison_frac), 1)
+    # poison_frac=0 must mean a genuinely clean control federation
+    n_poison_per = max(int(n_pad * poison_frac), 1) if poison_frac > 0 else 0
 
     if os.path.exists(path):
         with open(path, "rb") as f:

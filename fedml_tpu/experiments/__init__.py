@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import json
 import logging
-from typing import Optional
 
 from fedml_tpu.core.config import FedConfig
 
@@ -105,7 +104,7 @@ def run_experiment(config: FedConfig, algorithm: str) -> dict:
         if len(ds.train_x.shape) == 5:  # [C, n, H, W, ch] image data
             cb, sb = create_split_cnn(ds.class_num, input_shape=ds.train_x.shape[2:])
         else:
-            cb, sb = create_split_mlp(ds.class_num, input_dim=int(ds.train_x.shape[-1]))
+            cb, sb = create_split_mlp(ds.class_num, input_shape=ds.train_x.shape[2:])
         return SplitNNAPI(ds, config, cb, sb).train()
 
     from fedml_tpu.algorithms.centralized import CentralizedTrainer

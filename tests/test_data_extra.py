@@ -50,3 +50,27 @@ def test_uci_vertical_fallback():
     assert ds.num_parties == 2
     assert ds.party_dims == [5, 18]
     assert set(np.unique(ds.train_y)) <= {0.0, 1.0}
+
+
+def test_poison_frac_zero_is_clean_control():
+    """poison_frac=0 must leave every client untouched (clean baseline for
+    backdoor-defense comparisons)."""
+    base = make_synthetic_classification(
+        "pf0", (6, 6, 3), 4, 5, records_per_client=12,
+        partition_method="homo", batch_size=4, seed=0,
+    )
+    pf = load_poisoned_dataset(base, target_class=2, attacker_clients=[1],
+                               poison_frac=0.0, seed=1)
+    np.testing.assert_array_equal(pf.dataset.train_x, base.train_x)
+    np.testing.assert_array_equal(pf.dataset.train_y, base.train_y)
+
+
+def test_synthesized_edge_cases_exclude_target_class():
+    from fedml_tpu.data.edge_cases import _synthesize_edge_cases
+
+    base = make_synthetic_classification(
+        "pfx", (4, 4, 3), 5, 3, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=0,
+    )
+    _, y_true = _synthesize_edge_cases(base, 64, 3, np.random.default_rng(0))
+    assert not np.any(y_true == 3)

@@ -42,3 +42,15 @@ def test_splitnn_edge_protocol(small_ds):
     # every client turn ran its epochs and validated: 3 clients x 2 epochs
     assert len(server.val_history) == 6
     assert max(server.val_history) > 0.12
+
+
+def test_splitnn_dispatcher_flat_features():
+    """Launcher path for non-image datasets (regression: create_split_mlp
+    keyword mismatch made every flat-feature splitnn run crash)."""
+    from fedml_tpu.experiments import run_experiment
+
+    cfg = FedConfig(model="lr", dataset="synthetic_1_1", client_num_in_total=4,
+                    client_num_per_round=2, comm_round=1, batch_size=4,
+                    epochs=1, lr=0.1, ci=True)
+    hist = run_experiment(cfg, "splitnn")
+    assert np.isfinite(hist["epoch_loss"]).all()
