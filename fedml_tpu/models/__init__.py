@@ -67,7 +67,7 @@ def create_model(model_name: str, output_dim: int, input_shape: Optional[Sequenc
     (main_fedavg.py:232-267: lr, cnn, resnet18_gn, rnn, resnet56, mobilenet,
     ...)."""
     # Import lazily so optional model families don't slow cold start.
-    from fedml_tpu.models import cnn, linear, mobilenet, resnet, resnet_gn, rnn, segmentation, vgg  # noqa: F401
+    from fedml_tpu.models import cnn, linear, mobilenet, resnet, resnet_gn, rnn, segmentation, transformer, vgg  # noqa: F401
     try:
         from fedml_tpu.models import efficientnet  # noqa: F401
     except ImportError:
@@ -81,7 +81,7 @@ def create_model(model_name: str, output_dim: int, input_shape: Optional[Sequenc
 
 
 def known_models() -> list[str]:
-    from fedml_tpu.models import cnn, linear, mobilenet, resnet, resnet_gn, rnn, segmentation, vgg  # noqa: F401
+    from fedml_tpu.models import cnn, linear, mobilenet, resnet, resnet_gn, rnn, segmentation, transformer, vgg  # noqa: F401
     try:
         from fedml_tpu.models import efficientnet  # noqa: F401
     except ImportError:

@@ -1,0 +1,138 @@
+"""Decoder-only transformer LM — the TPU-first upgrade of the reference's
+RNN family (fedml_api/model/nlp/rnn.py:4-70 only ships 80/20-token LSTMs).
+
+Attention goes through :mod:`fedml_tpu.ops.attention` (fused blockwise
+kernel, MXU-shaped). When ``ring_axis`` is set the module must be applied
+inside a ``shard_map`` over that mesh axis: the sequence is sharded, K/V
+rotate around the ring (fedml_tpu/parallel/sequence.py), and
+``pos_offset`` gives the shard's global position for positional embeddings
+and causal masks — this is the framework's long-context path.
+
+Registered as ``transformer`` (char-level shakespeare default) and
+``transformer_nwp`` (stackoverflow word-level default) so every federated
+algorithm can train it like any other zoo model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models import ModelBundle, register_model
+from fedml_tpu.ops.attention import attention
+
+
+class SelfAttention(nn.Module):
+    dim: int
+    heads: int
+    attn_impl: str = "auto"
+    ring_axis: Optional[str] = None
+    ring_size: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, pos_offset=0):
+        b, t, _ = h.shape
+        d = self.dim // self.heads
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads_first(a):
+            return a.reshape(b, t, self.heads, d).transpose(0, 2, 1, 3)
+
+        q, k, v = heads_first(q), heads_first(k), heads_first(v)
+        if self.ring_axis is not None and self.ring_size > 1:
+            from fedml_tpu.parallel.sequence import ring_attention
+
+            o = ring_attention(q, k, v, axis_name=self.ring_axis,
+                               axis_size=self.ring_size, causal=True,
+                               impl=self.attn_impl)
+        else:
+            # single shard: pos_offset shifts q and k equally -> offsets 0
+            o = attention(q, k, v, causal=True, impl=self.attn_impl)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, self.dim)
+        return nn.Dense(self.dim, dtype=self.dtype, name="out")(o)
+
+
+class Block(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    attn_impl: str = "auto"
+    ring_axis: Optional[str] = None
+    ring_size: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, train: bool, pos_offset=0):
+        a = SelfAttention(self.dim, self.heads, self.attn_impl,
+                          self.ring_axis, self.ring_size, self.dtype,
+                          name="attn")(nn.LayerNorm(dtype=self.dtype)(h), pos_offset)
+        if self.dropout:
+            a = nn.Dropout(self.dropout, deterministic=not train)(a)
+        h = h + a
+        m = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype)(
+            nn.LayerNorm(dtype=self.dtype)(h))
+        m = nn.gelu(m)
+        m = nn.Dense(self.dim, dtype=self.dtype)(m)
+        if self.dropout:
+            m = nn.Dropout(self.dropout, deterministic=not train)(m)
+        return h + m
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int
+    dim: int = 256
+    heads: int = 8
+    layers: int = 4
+    mlp_ratio: int = 4
+    max_len: int = 4096
+    dropout: float = 0.0
+    attn_impl: str = "auto"
+    ring_axis: Optional[str] = None     # set to 'sp' for sequence parallelism
+    ring_size: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, pos_offset=0):
+        t = x.shape[1]
+        h = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                     name="tok_embed")(x.astype(jnp.int32))
+        pos = pos_offset + jnp.arange(t)
+        h = h + nn.Embed(self.max_len, self.dim, dtype=self.dtype,
+                         name="pos_embed")(pos)[None]
+        for i in range(self.layers):
+            h = Block(self.dim, self.heads, self.mlp_ratio, self.dropout,
+                      self.attn_impl, self.ring_axis, self.ring_size,
+                      self.dtype, name=f"block{i}")(h, train, pos_offset)
+        h = nn.LayerNorm(dtype=self.dtype)(h)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(h)
+
+
+def _bundle(name, vocab, seq_len, **kw):
+    sizes = dict(dim=kw.pop("dim", 256), heads=kw.pop("heads", 8),
+                 layers=kw.pop("layers", 4), dropout=kw.pop("dropout", 0.0))
+    module = TransformerLM(vocab_size=vocab, max_len=max(4096, seq_len),
+                           attn_impl=kw.pop("attn_impl", "auto"),
+                           ring_axis=kw.pop("ring_axis", None),
+                           ring_size=kw.pop("ring_size", 1),
+                           dtype=kw.pop("dtype", jnp.float32), **sizes)
+    return ModelBundle(
+        name=name, module=module, input_shape=(seq_len,),
+        input_dtype=jnp.int32, task="nwp",
+        uses_dropout=sizes["dropout"] > 0,
+    )
+
+
+@register_model("transformer")
+def _transformer(output_dim: int = 90, seq_len: int = 80, **kw):
+    return _bundle("transformer", output_dim or 90, seq_len, **kw)
+
+
+@register_model("transformer_nwp")
+def _transformer_nwp(output_dim: int = 10004, seq_len: int = 20, **kw):
+    return _bundle("transformer_nwp", output_dim or 10004, seq_len, **kw)

@@ -1,0 +1,24 @@
+"""TPU kernel ops (Pallas) with XLA fallbacks.
+
+The reference has no custom kernels (SURVEY.md §2: 100% Python/torch); its
+hot loop is eager per-batch SGD. Here the hot ops get TPU-native fused
+implementations:
+
+- :mod:`fedml_tpu.ops.attention` — blockwise (flash) attention: online
+  softmax over K/V blocks, MXU-shaped matmuls, partial (o, m, l) outputs so
+  sequence-parallel ring attention can merge chunks across devices.
+- :mod:`fedml_tpu.ops.xent` — fused masked softmax cross-entropy over large
+  vocabularies without materializing log-softmax in HBM.
+
+Every op has an ``impl`` switch: ``'pallas'`` (TPU kernel), ``'xla'``
+(pure-jnp, fuses well enough on any backend), ``'auto'`` (pallas on TPU,
+xla elsewhere). Tests run both paths and assert parity.
+"""
+
+from fedml_tpu.ops.attention import (  # noqa: F401
+    attention,
+    attention_block_partial,
+    merge_partials,
+    normalize_partial,
+)
+from fedml_tpu.ops.xent import masked_cross_entropy  # noqa: F401

@@ -1,0 +1,261 @@
+"""Blockwise (flash) attention for TPU.
+
+No counterpart exists in the reference — its only sequence models are tiny
+LSTMs (fedml_api/model/nlp/rnn.py:4-70, seq len 80/20). This op is what makes
+long-context federated NLP first-class on TPU: one fused kernel streams K/V
+blocks through VMEM with an online softmax, so attention never materializes
+the [T, T] score matrix in HBM, and the partial-result form (unnormalized
+output + running rowmax/rowsum) is exactly what ring attention over an 'sp'
+mesh axis needs to merge chunks arriving over ICI
+(:mod:`fedml_tpu.parallel.sequence`).
+
+Shapes: ``q, k, v`` are ``[B, H, Tq, D]`` / ``[B, H, Tk, D]``. Causal
+masking uses GLOBAL positions ``q_offset + i >= k_offset + j`` so the same
+code serves single-device attention (offsets 0) and ring steps (offsets are
+shard starts, traced scalars).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _pick_impl(impl: str) -> str:
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return impl
+
+
+# ---------------------------------------------------------------------------
+# XLA path: same online-softmax math in pure jnp. XLA fuses this into a few
+# kernels; it is the CPU/GPU fallback and the reference for kernel tests.
+# ---------------------------------------------------------------------------
+
+def _xla_block_partial(q, k, v, q_offset, k_offset, causal, sm_scale):
+    """One Q-shard vs one K/V-chunk -> unnormalized (o, m, l). [B,H,T,D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        qpos = q_offset + jnp.arange(tq)
+        kpos = k_offset + jnp.arange(tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B,H,Tq]
+    # rows that saw only masked keys: keep m at NEG_INF, contribute l=0
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)                                   # [B,H,Tq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+# ---------------------------------------------------------------------------
+# Pallas path
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                  o_ref, m_ref, l_ref, *,
+                  block_k: int, causal: bool, sm_scale: float, block_q: int):
+    """Grid point = (batch*heads, q_block). K/V chunk is fully resident; the
+    kernel streams it in block_k slices with an online softmax (running
+    rowmax m / rowsum l), accumulating the UNNORMALIZED output."""
+    import jax.experimental.pallas as pl
+
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                          # [bq, D]
+    tk = k_ref.shape[1]
+    nk = tk // block_k
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                                          # [bq, bk]
+        if causal:
+            qpos = qoff_ref[0] + qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = koff_ref[0] + i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    o_ref[0] = acc
+    # m/l are [bq, 1] — broadcast across the 128-lane dim of their outputs
+    m_ref[0] = jnp.broadcast_to(m, (block_q, 128))
+    l_ref[0] = jnp.broadcast_to(l, (block_q, 128))
+
+
+def _pallas_block_partial(q, k, v, q_offset, k_offset, causal, sm_scale,
+                          block_q: int, block_k: int, interpret: bool):
+    import jax.experimental.pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = min(block_q, tq)
+    bk = min(block_k, tk)
+    while tq % bq:
+        bq //= 2
+    while tk % bk:
+        bk //= 2
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
+
+    grid = (b * h, tq // bq)
+    kernel = functools.partial(
+        _flash_kernel, block_k=bk, causal=causal, sm_scale=sm_scale, block_q=bq)
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        smem = pltpu.SMEM
+        vmem = pltpu.VMEM
+    except ImportError:  # pragma: no cover
+        smem = vmem = None
+
+    def spec(block, index_map):
+        return pl.BlockSpec(block, index_map, memory_space=vmem)
+
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=smem),
+            pl.BlockSpec(memory_space=smem),
+            spec((1, bq, d), lambda bh, qb: (bh, qb, 0)),
+            spec((1, tk, d), lambda bh, qb: (bh, 0, 0)),
+            spec((1, tk, d), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=[
+            spec((1, bq, d), lambda bh, qb: (bh, qb, 0)),
+            spec((1, bq, 128), lambda bh, qb: (bh, qb, 0)),
+            spec((1, bq, 128), lambda bh, qb: (bh, qb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff, koff, qr, kr, vr)
+    return (o.reshape(b, h, tq, d),
+            m[..., 0].reshape(b, h, tq),
+            l[..., 0].reshape(b, h, tq))
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _partial_with_vjp(causal: bool, sm_scale: float, impl: str,
+                      block_q: int, block_k: int, interpret: bool):
+    """Partial-attention fn with a custom VJP: forward = fused pallas kernel
+    (or the XLA block math), backward = recompute via the XLA math (the
+    standard flash-attention trade: no [Tq, Tk] tensor saved in fwd; bwd
+    rebuilds scores once). Offsets travel as float32 scalars so custom_vjp
+    can hand back ordinary zero cotangents for them."""
+
+    def run_fwd(q, k, v, qoff, koff):
+        qi = qoff.astype(jnp.int32)
+        ki = koff.astype(jnp.int32)
+        if impl == "xla":
+            return _xla_block_partial(q, k, v, qi, ki, causal, sm_scale)
+        return _pallas_block_partial(q, k, v, qi, ki, causal, sm_scale,
+                                     block_q, block_k, interpret)
+
+    @jax.custom_vjp
+    def f(q, k, v, qoff, koff):
+        return run_fwd(q, k, v, qoff, koff)
+
+    def fwd(q, k, v, qoff, koff):
+        return f(q, k, v, qoff, koff), (q, k, v, qoff, koff)
+
+    def bwd(res, ct):
+        q, k, v, qoff, koff = res
+        qi = qoff.astype(jnp.int32)
+        ki = koff.astype(jnp.int32)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _xla_block_partial(q_, k_, v_, qi, ki,
+                                                  causal, sm_scale),
+            q, k, v)
+        dq, dk, dv = vjp(ct)
+        return dq, dk, dv, jnp.zeros_like(qoff), jnp.zeros_like(koff)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def attention_block_partial(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    q_offset=0, k_offset=0, causal: bool = True,
+    sm_scale: Optional[float] = None, impl: str = "auto",
+    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Attention of a Q shard against one K/V chunk -> partial result
+    ``(o_unnormalized, rowmax m, rowsum l)``, each fp32. Merge partials from
+    several chunks with :func:`merge_partials`, finish with
+    :func:`normalize_partial`. Differentiable (custom VJP, recompute-style
+    backward)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    impl = _pick_impl(impl)
+    f = _partial_with_vjp(causal, float(sm_scale), impl, block_q, block_k,
+                          interpret)
+    return f(q, k, v, jnp.asarray(q_offset, jnp.float32),
+             jnp.asarray(k_offset, jnp.float32))
+
+
+def merge_partials(a, b):
+    """Online-softmax merge of two partial results (associative)."""
+    oa, ma, la = a
+    ob, mb, lb = b
+    m = jnp.maximum(ma, mb)
+    wa = jnp.where(ma <= NEG_INF / 2, 0.0, jnp.exp(ma - m))
+    wb = jnp.where(mb <= NEG_INF / 2, 0.0, jnp.exp(mb - m))
+    return (oa * wa[..., None] + ob * wb[..., None], m, la * wa + lb * wb)
+
+
+def normalize_partial(o, m, l, out_dtype=None):
+    """Finish: divide the accumulated unnormalized output by the rowsum."""
+    den = jnp.where(l == 0.0, 1.0, l)[..., None]
+    out = o / den
+    return out.astype(out_dtype) if out_dtype is not None else out
+
+
+def attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    causal: bool = True, sm_scale: Optional[float] = None,
+    impl: str = "auto", block_q: int = 128, block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Full fused attention, ``[B, H, T, D] -> [B, H, T, D]`` (q.dtype)."""
+    o, m, l = attention_block_partial(
+        q, k, v, causal=causal, sm_scale=sm_scale, impl=impl,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return normalize_partial(o, m, l, out_dtype=q.dtype)
