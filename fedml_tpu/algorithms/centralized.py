@@ -76,3 +76,83 @@ class CentralizedTrainer:
                 history["Test/Acc"].append(m.get("acc"))
                 history["Test/Loss"].append(m.get("loss"))
         return history
+
+
+class StreamingCentralizedTrainer:
+    """Centralized training for datasets that do NOT fit on device: batches
+    are assembled by the native threaded pipeline (fedml_tpu/native) and
+    double-buffered onto the device while the previous step computes. One
+    jitted per-batch SGD step with donated state; the device never waits on
+    the Python interpreter for batch assembly."""
+
+    def __init__(self, dataset: FedDataset, config: FedConfig, bundle: ModelBundle | None = None,
+                 n_threads: int = 4, depth: int = 6):
+        from fedml_tpu.parallel.local import make_optimizer
+
+        self.dataset = dataset
+        self.config = config
+        self.bundle = bundle or create_model(
+            config.model, dataset.class_num, input_shape=dataset.train_x.shape[2:] or None
+        )
+        self.task = get_task(dataset.task, dataset.class_num)
+        self.root_key = seed_everything(config.seed)
+        self.variables = self.bundle.init(self.root_key)
+        self.n_threads, self.depth = n_threads, depth
+        x, y, mask = merge_clients(dataset, config.batch_size)
+        keep = mask > 0
+        self.x, self.y = x[keep], y[keep]
+        self.tx = make_optimizer(config.client_optimizer, config.lr, config.momentum, config.wd)
+        self.opt_state = self.tx.init(self.variables["params"])
+        bundle, task, tx, clip = self.bundle, self.task, self.tx, config.grad_clip
+
+        def step(variables, opt_state, bx, by, key):
+            import optax
+
+            def loss_fn(params):
+                v = dict(variables)
+                v["params"] = params
+                logits, new_vars = bundle.apply_train(v, bx, key)
+                m = jnp.ones(by.shape[0], jnp.float32)
+                return task.loss(logits, by, m), new_vars
+
+            (loss, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(variables["params"])
+            new_vars = dict(new_vars)
+            if clip:
+                gnorm = optax.global_norm(grads)
+                scale = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree.map(lambda g: g * scale, grads)
+            ups, opt_state = tx.update(grads, opt_state, variables["params"])
+            new_vars["params"] = optax.apply_updates(variables["params"], ups)
+            return new_vars, opt_state, loss
+
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._eval = make_eval_fn(self.bundle, self.task)
+
+    def train(self) -> dict:
+        from fedml_tpu.data.pipeline import HostPipeline, device_stream
+
+        history = {"round": [], "Test/Acc": [], "Test/Loss": []}
+        x, y = self.x, self.y
+        if len(x) < self.config.batch_size:  # tiny sets: repeat to one batch
+            reps = -(-self.config.batch_size // len(x))
+            x = np.concatenate([x] * reps)[: self.config.batch_size]
+            y = np.concatenate([y] * reps)[: self.config.batch_size]
+        step_no = 0
+        with HostPipeline(x, y, self.config.batch_size, seed=self.config.seed,
+                          n_threads=self.n_threads, depth=self.depth,
+                          drop_last=True) as pipe:
+            for r in range(self.config.comm_round):
+                for _ in range(self.config.epochs):
+                    for bx, by in device_stream(pipe):
+                        self.variables, self.opt_state, _ = self._step(
+                            self.variables, self.opt_state, bx, by,
+                            round_key(self.root_key, step_no))
+                        step_no += 1
+                if r % self.config.frequency_of_the_test == 0 or r == self.config.comm_round - 1:
+                    m = finalize_metrics(jax.tree.map(np.asarray, self._eval(
+                        self.variables, self.dataset.test_x, self.dataset.test_y,
+                        self.dataset.test_mask)))
+                    history["round"].append(r)
+                    history["Test/Acc"].append(m.get("acc"))
+                    history["Test/Loss"].append(m.get("loss"))
+        return history

@@ -1,0 +1,47 @@
+"""Host-side streaming input pipeline.
+
+The in-memory path (data/batching.py) stacks the whole federation into
+device arrays — right when the dataset fits in HBM. For datasets that do
+not (ImageNet/Landmarks scale), this module streams: the native threaded
+batcher (fedml_tpu/native.HostPipeline, C++ workers assembling shuffled
+batches off-GIL) feeds a double-buffered host→device prefetcher, so batch
+assembly and PCIe/ICI transfer overlap device compute — the TPU-native
+counterpart of the reference's DataLoader worker processes
+(cifar10/data_loader.py DataLoader(..., shuffle=True)).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+from fedml_tpu.native import HostPipeline
+
+__all__ = ["HostPipeline", "device_stream"]
+
+
+def device_stream(
+    pipeline: HostPipeline,
+    n_batches: Optional[int] = None,
+    prefetch: int = 2,
+    device=None,
+) -> Iterator[tuple]:
+    """Yield (x, y) already resident on ``device``, keeping ``prefetch``
+    transfers in flight ahead of the consumer. ``n_batches=None`` streams
+    one epoch."""
+    if n_batches is None:
+        n_batches = pipeline.batches_per_epoch
+    if device is None:
+        device = jax.devices()[0]
+    buf = []
+    for _ in range(n_batches):
+        bx, by = pipeline.next_batch()
+        item = (jax.device_put(bx, device),
+                None if by is None else jax.device_put(by, device))
+        buf.append(item)
+        if len(buf) > prefetch:
+            yield buf.pop(0)
+    while buf:
+        yield buf.pop(0)

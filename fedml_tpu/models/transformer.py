@@ -34,7 +34,7 @@ class SelfAttention(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, h, pos_offset=0):
+    def __call__(self, h):
         b, t, _ = h.shape
         d = self.dim // self.heads
         qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
@@ -51,7 +51,6 @@ class SelfAttention(nn.Module):
                                axis_size=self.ring_size, causal=True,
                                impl=self.attn_impl)
         else:
-            # single shard: pos_offset shifts q and k equally -> offsets 0
             o = attention(q, k, v, causal=True, impl=self.attn_impl)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.dim)
         return nn.Dense(self.dim, dtype=self.dtype, name="out")(o)
@@ -68,10 +67,10 @@ class Block(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, h, train: bool, pos_offset=0):
+    def __call__(self, h, train: bool):
         a = SelfAttention(self.dim, self.heads, self.attn_impl,
                           self.ring_axis, self.ring_size, self.dtype,
-                          name="attn")(nn.LayerNorm(dtype=self.dtype)(h), pos_offset)
+                          name="attn")(nn.LayerNorm(dtype=self.dtype)(h))
         if self.dropout:
             a = nn.Dropout(self.dropout, deterministic=not train)(a)
         h = h + a
@@ -108,14 +107,15 @@ class TransformerLM(nn.Module):
         for i in range(self.layers):
             h = Block(self.dim, self.heads, self.mlp_ratio, self.dropout,
                       self.attn_impl, self.ring_axis, self.ring_size,
-                      self.dtype, name=f"block{i}")(h, train, pos_offset)
+                      self.dtype, name=f"block{i}")(h, train)
         h = nn.LayerNorm(dtype=self.dtype)(h)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(h)
 
 
 def _bundle(name, vocab, seq_len, **kw):
     sizes = dict(dim=kw.pop("dim", 256), heads=kw.pop("heads", 8),
-                 layers=kw.pop("layers", 4), dropout=kw.pop("dropout", 0.0))
+                 layers=kw.pop("layers", 4), dropout=kw.pop("dropout", 0.0),
+                 mlp_ratio=kw.pop("mlp_ratio", 4))
     module = TransformerLM(vocab_size=vocab, max_len=max(4096, seq_len),
                            attn_impl=kw.pop("attn_impl", "auto"),
                            ring_axis=kw.pop("ring_axis", None),

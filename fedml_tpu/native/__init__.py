@@ -1,0 +1,272 @@
+"""ctypes bindings for the native runtime (fedml_tpu/native/src/fedml_native.cc).
+
+The reference framework is 100% Python (SURVEY.md §2: zero native
+components) and its IO layer shows it — pickled state dicts and
+interpreter-assembled batches. This package provides the C++ hot paths for
+the runtime AROUND the XLA compute: frame integrity (crc32c), wire
+pack/unpack (parallel gather/scatter memcpy), and a threaded host data
+pipeline. Every entry point has a pure-Python fallback so the framework
+works without a compiler; ``available()`` reports which is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_lib = None
+_lib_lock = threading.Lock()
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("FEDML_TPU_NO_NATIVE"):
+            return None
+        try:
+            from fedml_tpu.native.build import build_library
+
+            path = build_library()
+            if path is None:
+                return None
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
+        lib.fed_crc32c.restype = ctypes.c_uint32
+        lib.fed_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
+        lib.fed_gather_copy.restype = None
+        lib.fed_gather_copy.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.fed_scatter_copy.restype = None
+        lib.fed_scatter_copy.argtypes = lib.fed_gather_copy.argtypes
+        lib.fed_pipeline_create.restype = ctypes.c_void_p
+        lib.fed_pipeline_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.fed_pipeline_next.restype = ctypes.c_int64
+        lib.fed_pipeline_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.fed_pipeline_batches_per_epoch.restype = ctypes.c_int64
+        lib.fed_pipeline_batches_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.fed_pipeline_destroy.restype = None
+        lib.fed_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# --- crc32c -----------------------------------------------------------------
+
+_CRC_TABLE = None
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        tab = np.zeros(256, dtype=np.uint32)
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (poly ^ (c >> 1)) if (c & 1) else (c >> 1)
+            tab[i] = c
+        _CRC_TABLE = tab
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes | memoryview | np.ndarray, seed: int = 0) -> int:
+    """crc32c (Castagnoli). Native when available, table-driven numpy-ish
+    Python otherwise (slow path is fine: it only runs compiler-less)."""
+    buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data.view(np.uint8).ravel()
+    lib = _load()
+    if lib is not None:
+        buf = np.ascontiguousarray(buf)
+        return int(lib.fed_crc32c(buf.ctypes.data, buf.size, ctypes.c_uint32(seed)))
+    tab = _crc_table()
+    crc = (~seed) & 0xFFFFFFFF
+    for b in buf.tobytes():
+        crc = (int(tab[(crc ^ b) & 0xFF]) ^ (crc >> 8)) & 0xFFFFFFFF
+    return (~crc) & 0xFFFFFFFF
+
+
+# --- pack/unpack ------------------------------------------------------------
+
+def pack_buffers(arrays: Sequence[np.ndarray], out: Optional[bytearray] = None,
+                 offset: int = 0, n_threads: int = 0) -> bytearray:
+    """Concatenate arrays' raw bytes into ``out`` starting at ``offset``,
+    with a threaded native gather when available. Returns ``out``."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    sizes = [a.nbytes for a in arrays]
+    total = offset + sum(sizes)
+    if out is None:
+        out = bytearray(total)
+    elif len(out) < total:
+        raise ValueError(f"out too small: {len(out)} < {total}")
+    lib = _load()
+    offs, run = [], offset
+    for s in sizes:
+        offs.append(run)
+        run += s
+    if lib is not None and arrays:
+        n = len(arrays)
+        src_ptrs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+        c_sizes = (ctypes.c_uint64 * n)(*sizes)
+        c_offs = (ctypes.c_uint64 * n)(*offs)
+        dst = (ctypes.c_uint8 * len(out)).from_buffer(out)
+        if n_threads <= 0:
+            n_threads = min(8, os.cpu_count() or 1)
+        lib.fed_gather_copy(ctypes.addressof(dst), src_ptrs, c_sizes, c_offs, n, n_threads)
+    else:
+        mv = memoryview(out)
+        for a, o, s in zip(arrays, offs, sizes):
+            mv[o:o + s] = a.tobytes() if a.nbytes else b""
+    return out
+
+
+def unpack_buffers(buf, specs: Sequence[tuple[tuple, str]], offset: int = 0,
+                   n_threads: int = 0) -> list[np.ndarray]:
+    """Slice ``buf`` (bytes-like) back into arrays per (shape, dtype) specs,
+    scatter-copied natively when available. Always copies (the result owns
+    its memory, detached from the wire buffer)."""
+    src = np.frombuffer(buf, dtype=np.uint8)
+    outs, offs, sizes = [], [], []
+    run = offset
+    for shape, dtype in specs:
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape)) if len(shape) else 1
+        a = np.empty(shape, dtype=dt)
+        outs.append(a)
+        offs.append(run)
+        sizes.append(n * dt.itemsize)
+        run += n * dt.itemsize
+    if run > src.size:
+        raise ValueError("buffer too small for specs")
+    lib = _load()
+    if lib is not None and outs:
+        k = len(outs)
+        dst_ptrs = (ctypes.c_void_p * k)(*[a.ctypes.data for a in outs])
+        c_sizes = (ctypes.c_uint64 * k)(*sizes)
+        c_offs = (ctypes.c_uint64 * k)(*offs)
+        if n_threads <= 0:
+            n_threads = min(8, os.cpu_count() or 1)
+        lib.fed_scatter_copy(src.ctypes.data, dst_ptrs, c_sizes, c_offs, k, n_threads)
+    else:
+        for a, o, s in zip(outs, offs, sizes):
+            a.view(np.uint8).ravel()[:] = src[o:o + s] if a.nbytes else a.view(np.uint8).ravel()
+    return outs
+
+
+# --- host data pipeline -----------------------------------------------------
+
+class HostPipeline:
+    """Deterministic threaded shuffled batcher over (x, y) record arrays.
+
+    Produces an infinite in-order stream of batches; each epoch is an
+    independent Fisher-Yates permutation of the records derived from
+    (seed, epoch). Worker threads assemble batches into a bounded ring
+    concurrently with the consumer (which is typically blocked in device
+    compute) — the native replacement for DataLoader worker processes.
+
+    Falls back to a single-threaded Python implementation (same API,
+    different but still deterministic permutation stream) without the
+    native library.
+    """
+
+    def __init__(self, x: np.ndarray, y: Optional[np.ndarray], batch_size: int,
+                 seed: int = 0, n_threads: int = 2, depth: int = 4,
+                 drop_last: bool = False):
+        self.x = np.ascontiguousarray(x)
+        self.y = None if y is None else np.ascontiguousarray(y)
+        if self.y is not None and len(self.y) != len(self.x):
+            raise ValueError("x/y length mismatch")
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.drop_last = bool(drop_last)
+        n = len(self.x)
+        self.batches_per_epoch = (n // self.batch_size if drop_last
+                                  else -(-n // self.batch_size))
+        if self.batches_per_epoch <= 0:
+            raise ValueError("dataset smaller than one batch with drop_last")
+        self._handle = None
+        self._lib = _load()
+        if self._lib is not None:
+            xb = self.x.nbytes // n
+            yb = 0 if self.y is None else self.y.nbytes // n
+            self._handle = self._lib.fed_pipeline_create(
+                self.x.ctypes.data,
+                0 if self.y is None else self.y.ctypes.data,
+                n, xb, yb, self.batch_size, self.seed,
+                int(n_threads), int(depth), int(drop_last),
+            )
+        if self._handle is None:
+            self._rng_epoch = 0
+            self._py_iter = self._python_stream()
+
+    def _python_stream(self):
+        n = len(self.x)
+        epoch = 0
+        while True:
+            rng = np.random.default_rng(self.seed + epoch * 1_000_003)
+            perm = rng.permutation(n)
+            nb = self.batches_per_epoch
+            for b in range(nb):
+                ix = perm[b * self.batch_size:(b + 1) * self.batch_size]
+                yield self.x[ix], None if self.y is None else self.y[ix]
+            epoch += 1
+
+    def next_batch(self) -> tuple[np.ndarray, Optional[np.ndarray]]:
+        """Next (x, y) batch; the final batch of an epoch may be short when
+        drop_last is False."""
+        if self._handle is None:
+            return next(self._py_iter)
+        bx = np.empty((self.batch_size,) + self.x.shape[1:], dtype=self.x.dtype)
+        by = (None if self.y is None
+              else np.empty((self.batch_size,) + self.y.shape[1:], dtype=self.y.dtype))
+        count = self._lib.fed_pipeline_next(
+            self._handle, bx.ctypes.data,
+            0 if by is None else by.ctypes.data)
+        if count < 0:
+            raise RuntimeError("pipeline stopped")
+        if count < self.batch_size:
+            bx = bx[:count]
+            by = None if by is None else by[:count]
+        return bx, by
+
+    def epoch(self):
+        """Yield exactly one epoch's batches."""
+        for _ in range(self.batches_per_epoch):
+            yield self.next_batch()
+
+    def close(self):
+        if self._handle is not None and self._lib is not None:
+            self._lib.fed_pipeline_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

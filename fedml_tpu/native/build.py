@@ -1,0 +1,58 @@
+"""Build the native runtime shared library on first use.
+
+No pybind11 in this image, so the library is a plain C-ABI ``.so`` compiled
+with g++ and consumed via ctypes (fedml_tpu/native/__init__.py). The build is
+cached next to the source keyed by a hash of the source text + compiler
+flags; rebuilds happen only when either changes. Everything degrades to the
+pure-Python fallbacks if no compiler is present.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).parent / "src" / "fedml_native.cc"
+_BUILD_DIR = Path(__file__).parent / "_build"
+_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", "-Wall"]
+
+
+def _key() -> str:
+    h = hashlib.sha256()
+    h.update(_SRC.read_bytes())
+    h.update(" ".join(_FLAGS).encode())
+    return h.hexdigest()[:16]
+
+
+def build_library(quiet: bool = True) -> Optional[Path]:
+    """Compile (or reuse the cached) libfedml_native.so; None if impossible."""
+    compiler = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if compiler is None or not _SRC.exists():
+        return None
+    out = _BUILD_DIR / f"libfedml_native-{_key()}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(exist_ok=True)
+    # Build into a temp file then atomically rename, so concurrent test
+    # workers never dlopen a half-written .so.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    cmd = [compiler, *_FLAGS, str(_SRC), "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            if not quiet:
+                raise RuntimeError(f"native build failed:\n{proc.stderr}")
+            return None
+        os.replace(tmp, out)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)

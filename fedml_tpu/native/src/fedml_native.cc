@@ -1,0 +1,298 @@
+// fedml_tpu native runtime: the IO/memory hot paths that sit AROUND the
+// XLA compute path (task: serialization hot path + host-side data pipeline).
+//
+// The reference framework is pure Python (SURVEY.md §2: "Native-code
+// components: NONE") and pays for it: state dicts cross the wire as
+// pickled dicts (mpi_send_thread.py:27) or JSON nested lists
+// (fedavg/utils.py:7-16), and every DataLoader batch is assembled by the
+// Python interpreter. Here the equivalents are C++:
+//
+//   1. crc32c (Castagnoli, slice-by-8) — integrity trailer for wire frames
+//      and checkpoint files.
+//   2. parallel gather/scatter memcpy — pack N pytree leaves into one wire
+//      buffer / unpack one buffer into N leaf arrays, threaded for large
+//      payloads.
+//   3. a bounded, threaded, deterministic host data pipeline — Fisher-Yates
+//      shuffle per epoch (mt19937_64, seeded), worker threads gather records
+//      into a ring of slots, consumer receives batches IN ORDER. This is the
+//      native replacement for torch DataLoader workers: it overlaps batch
+//      assembly with device compute without holding the GIL.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// crc32c, slice-by-8
+// ---------------------------------------------------------------------------
+
+namespace {
+
+uint32_t g_crc_tab[8][256];
+std::once_flag g_crc_once;
+
+void crc_init() {
+  const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+    g_crc_tab[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; ++i)
+    for (int t = 1; t < 8; ++t)
+      g_crc_tab[t][i] =
+          (g_crc_tab[t - 1][i] >> 8) ^ g_crc_tab[0][g_crc_tab[t - 1][i] & 0xFF];
+}
+
+}  // namespace
+
+extern "C" uint32_t fed_crc32c(const uint8_t* p, uint64_t n, uint32_t seed) {
+  std::call_once(g_crc_once, crc_init);
+  uint32_t crc = ~seed;
+  while (n && (reinterpret_cast<uintptr_t>(p) & 7)) {
+    crc = g_crc_tab[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+    --n;
+  }
+  while (n >= 8) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    w ^= crc;  // little-endian assumed (x86-64 / aarch64-le)
+    crc = g_crc_tab[7][w & 0xFF] ^ g_crc_tab[6][(w >> 8) & 0xFF] ^
+          g_crc_tab[5][(w >> 16) & 0xFF] ^ g_crc_tab[4][(w >> 24) & 0xFF] ^
+          g_crc_tab[3][(w >> 32) & 0xFF] ^ g_crc_tab[2][(w >> 40) & 0xFF] ^
+          g_crc_tab[1][(w >> 48) & 0xFF] ^ g_crc_tab[0][(w >> 56) & 0xFF];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = g_crc_tab[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// parallel gather/scatter copy (wire pack/unpack hot path)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Split [0, n) leaf indices across threads by cumulative byte weight.
+void run_sharded_copy(uint64_t n, const uint64_t* sizes, int n_threads,
+                      const std::function<void(uint64_t)>& copy_one) {
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; ++i) total += sizes[i];
+  if (n_threads <= 1 || total < (8u << 20) || n < 2) {
+    for (uint64_t i = 0; i < n; ++i) copy_one(i);
+    return;
+  }
+  std::atomic<uint64_t> next{0};
+  auto worker = [&] {
+    for (;;) {
+      uint64_t i = next.fetch_add(1, std::memory_order_relaxed);
+      if (i >= n) return;
+      copy_one(i);
+    }
+  };
+  std::vector<std::thread> ts;
+  int nt = std::min<int>(n_threads, static_cast<int>(n));
+  ts.reserve(nt - 1);
+  for (int t = 1; t < nt; ++t) ts.emplace_back(worker);
+  worker();
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+// Pack: copy srcs[i] (sizes[i] bytes) to dst at offsets[i].
+extern "C" void fed_gather_copy(uint8_t* dst, const uint8_t* const* srcs,
+                                const uint64_t* sizes, const uint64_t* offsets,
+                                uint64_t n, int n_threads) {
+  run_sharded_copy(n, sizes, n_threads, [&](uint64_t i) {
+    std::memcpy(dst + offsets[i], srcs[i], sizes[i]);
+  });
+}
+
+// Unpack: copy src at offsets[i] into dsts[i].
+extern "C" void fed_scatter_copy(const uint8_t* src, uint8_t* const* dsts,
+                                 const uint64_t* sizes, const uint64_t* offsets,
+                                 uint64_t n, int n_threads) {
+  run_sharded_copy(n, sizes, n_threads, [&](uint64_t i) {
+    std::memcpy(dsts[i], src + offsets[i], sizes[i]);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// host data pipeline
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Slot {
+  std::vector<uint8_t> x, y;
+  int64_t count = 0;    // records in this batch
+  int64_t seq = -1;     // which global batch sequence number it holds
+  bool ready = false;
+};
+
+struct Pipeline {
+  const uint8_t* x;
+  const uint8_t* y;
+  int64_t n_records, x_rec_bytes, y_rec_bytes, batch;
+  bool drop_last;
+  uint64_t seed;
+  int64_t n_batches;  // per epoch
+
+  std::vector<Slot> slots;
+  std::atomic<int64_t> next_fetch{0};  // next batch seq to be produced
+  int64_t next_deliver = 0;            // next batch seq the consumer takes
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  bool stop = false;
+  std::vector<std::thread> workers;
+
+  // Permutations per epoch, built lazily; pruned below the oldest epoch any
+  // in-flight batch can reference (workers run at most `depth` batches ahead
+  // of the consumer, so keeping the last two epochs is always enough).
+  std::map<int64_t, std::vector<int64_t>> perms;
+  std::mutex perm_mu;
+
+  const std::vector<int64_t>& perm_for_epoch(int64_t e) {
+    std::lock_guard<std::mutex> g(perm_mu);
+    auto it = perms.find(e);
+    if (it != perms.end()) return it->second;
+    std::vector<int64_t> p(n_records);
+    for (int64_t i = 0; i < n_records; ++i) p[i] = i;
+    std::mt19937_64 rng(seed + static_cast<uint64_t>(e) * 0x9E3779B97F4A7C15ull);
+    for (int64_t i = n_records - 1; i > 0; --i) {
+      int64_t j = static_cast<int64_t>(rng() % static_cast<uint64_t>(i + 1));
+      std::swap(p[i], p[j]);
+    }
+    while (perms.size() >= 3) perms.erase(perms.begin());
+    return perms.emplace(e, std::move(p)).first->second;
+  }
+
+  void fill(int64_t seq_no, Slot& s) {
+    int64_t epoch = seq_no / n_batches;
+    int64_t b = seq_no % n_batches;
+    const auto& perm = perm_for_epoch(epoch);
+    int64_t start = b * batch;
+    int64_t count = std::min(batch, n_records - start);
+    for (int64_t r = 0; r < count; ++r) {
+      int64_t src = perm[start + r];
+      std::memcpy(s.x.data() + r * x_rec_bytes, x + src * x_rec_bytes,
+                  x_rec_bytes);
+      if (y_rec_bytes)
+        std::memcpy(s.y.data() + r * y_rec_bytes, y + src * y_rec_bytes,
+                    y_rec_bytes);
+    }
+    s.count = count;
+  }
+
+  void worker_loop() {
+    for (;;) {
+      int64_t seq_no = next_fetch.fetch_add(1, std::memory_order_relaxed);
+      Slot& s = slots[seq_no % slots.size()];
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        // Wait until the consumer has drained whatever previously lived in
+        // this ring slot (in-order delivery guarantees seq-depth precedes us).
+        cv_free.wait(lk, [&] { return stop || (!s.ready && next_deliver + static_cast<int64_t>(slots.size()) > seq_no); });
+        if (stop) return;
+      }
+      fill(seq_no, s);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        s.seq = seq_no;
+        s.ready = true;
+      }
+      cv_ready.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" void* fed_pipeline_create(const uint8_t* x, const uint8_t* y,
+                                     int64_t n_records, int64_t x_rec_bytes,
+                                     int64_t y_rec_bytes, int64_t batch,
+                                     uint64_t seed, int n_threads, int depth,
+                                     int drop_last) {
+  if (n_records <= 0 || batch <= 0 || x_rec_bytes <= 0) return nullptr;
+  auto* p = new Pipeline;
+  p->x = x;
+  p->y = y;
+  p->n_records = n_records;
+  p->x_rec_bytes = x_rec_bytes;
+  p->y_rec_bytes = y_rec_bytes;
+  p->batch = batch;
+  p->drop_last = drop_last != 0;
+  p->seed = seed;
+  p->n_batches = p->drop_last ? n_records / batch
+                              : (n_records + batch - 1) / batch;
+  if (p->n_batches <= 0) {
+    delete p;
+    return nullptr;
+  }
+  if (depth < 2) depth = 2;
+  p->slots.resize(depth);
+  for (auto& s : p->slots) {
+    s.x.resize(static_cast<size_t>(batch) * x_rec_bytes);
+    s.y.resize(static_cast<size_t>(batch) * (y_rec_bytes ? y_rec_bytes : 1));
+  }
+  if (n_threads < 1) n_threads = 1;
+  n_threads = std::min<int>(n_threads, depth);
+  for (int t = 0; t < n_threads; ++t)
+    p->workers.emplace_back([p] { p->worker_loop(); });
+  return p;
+}
+
+// Blocks until the next in-order batch is ready, copies it to x_out/y_out,
+// frees the slot. Returns the record count in the batch (full batches =
+// `batch`, the final non-drop_last batch of an epoch may be smaller).
+extern "C" int64_t fed_pipeline_next(void* pv, uint8_t* x_out, uint8_t* y_out) {
+  auto* p = static_cast<Pipeline*>(pv);
+  int64_t want = p->next_deliver;
+  Slot& s = p->slots[want % p->slots.size()];
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_ready.wait(lk, [&] { return p->stop || (s.ready && s.seq == want); });
+    if (p->stop) return -1;
+  }
+  int64_t count = s.count;
+  std::memcpy(x_out, s.x.data(), static_cast<size_t>(count) * p->x_rec_bytes);
+  if (p->y_rec_bytes && y_out)
+    std::memcpy(y_out, s.y.data(), static_cast<size_t>(count) * p->y_rec_bytes);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    s.ready = false;
+    p->next_deliver = want + 1;
+  }
+  p->cv_free.notify_all();
+  return count;
+}
+
+extern "C" int64_t fed_pipeline_batches_per_epoch(void* pv) {
+  return static_cast<Pipeline*>(pv)->n_batches;
+}
+
+extern "C" void fed_pipeline_destroy(void* pv) {
+  auto* p = static_cast<Pipeline*>(pv);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->cv_ready.notify_all();
+  p->cv_free.notify_all();
+  for (auto& t : p->workers) t.join();
+  delete p;
+}
+
+extern "C" int fed_native_abi_version() { return 1; }

@@ -67,9 +67,16 @@ def _pallas_xent(logits, labels, block_n: int, block_v: int, interpret: bool):
     bn = min(block_n, n)
     while n % bn:
         bn //= 2
-    bv = min(block_v, v)
-    while v % bv:
-        bv //= 2
+    # Keep the vocab block wide regardless of V's factorization (a 10004
+    # vocab must not collapse the block to 4 lanes): pad V up to a block
+    # multiple with -1e30 columns — exp(-1e30 - m) == 0, so padding columns
+    # never perturb the running (max, sumexp) and labels never hit them.
+    bv = min(block_v, -(-v // 128) * 128)
+    v_pad = -(-v // bv) * bv
+    if v_pad != v:
+        logits = jnp.pad(logits, ((0, 0), (0, v_pad - v)),
+                         constant_values=-1e30)
+    v = v_pad
 
     out = pl.pallas_call(
         functools.partial(_xent_kernel, block_n=bn, block_v=bv),

@@ -58,19 +58,25 @@ def ring_attention(
     m0 = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
     l0 = jnp.zeros(q.shape[:3], jnp.float32)
 
-    def step(carry, i):
-        (o, m, l), k_cur, v_cur = carry
+    def compute(acc, k_cur, v_cur, i):
         src = (idx - i) % axis_size          # whose shard we hold this step
         part = attention_block_partial(
             q, k_cur, v_cur, q_offset=q_off, k_offset=src * tl,
             causal=causal, sm_scale=sm_scale, impl=impl, interpret=interpret)
-        merged = merge_partials((o, m, l), part)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (merged, k_nxt, v_nxt), None
+        return merge_partials(acc, part)
 
-    (acc, _, _), _ = jax.lax.scan(step, ((o0, m0, l0), k, v),
-                                  jnp.arange(axis_size))
+    # step 0 on the resident shard, then permute-then-compute for the rest:
+    # exactly axis_size-1 ppermutes (no dead final rotation on the wire).
+    acc = compute((o0, m0, l0), k, v, 0)
+
+    def step(carry, i):
+        acc, k_cur, v_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (compute(acc, k_cur, v_cur, i), k_cur, v_cur), None
+
+    (acc, _, _), _ = jax.lax.scan(step, (acc, k, v),
+                                  jnp.arange(1, axis_size))
     return normalize_partial(*acc, out_dtype=q.dtype)
 
 

@@ -106,6 +106,18 @@ class TestXent:
                                  interpret=True, block_n=16, block_v=32)
         np.testing.assert_allclose(a, b, atol=1e-5)
 
+    def test_pallas_odd_vocab_pads_not_collapses(self):
+        """Awkward V (e.g. 10004 = 4*41*61) must pad up to the block width,
+        not halve the block down to a few lanes."""
+        rng = np.random.default_rng(7)
+        v = 1003  # prime-ish: no power-of-2 factor above 1
+        logits = jnp.asarray(rng.normal(size=(8, v)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, v, size=(8,)), jnp.int32)
+        a = masked_cross_entropy(logits, labels, impl="xla")
+        b = masked_cross_entropy(logits, labels, impl="pallas",
+                                 interpret=True, block_n=8, block_v=256)
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
     def test_grad_closed_form(self):
         """Custom VJP (softmax - onehot) == autodiff of log_softmax CE."""
         rng = np.random.default_rng(3)
