@@ -45,6 +45,8 @@ class FedAvgAPI:
 
     #: hook for subclasses (FedOpt/FedNova/...) to transform the aggregate
     server_update: Optional[Callable] = None
+    #: subclasses that shard round inputs themselves (cross-silo) opt out
+    supports_device_data: bool = True
 
     def __init__(self, dataset: FedDataset, config: FedConfig, bundle: Optional[ModelBundle] = None):
         self.dataset = dataset
@@ -60,7 +62,54 @@ class FedAvgAPI:
         self._eval = make_eval_fn(self.bundle, self.task)
         self.server_state = self.init_server_state()
         self._round_step = self.build_round_step()
+        self._dev_train = self._maybe_place_train_data()
+        if self._dev_train is not None:
+            self._round_step_gather = self.build_round_step_gather()
         self.history: dict[str, list] = {"round": [], "Test/Acc": [], "Test/Loss": []}
+
+    def _maybe_place_train_data(self):
+        """Ship the full stacked client dataset to HBM once so rounds gather
+        the cohort on device instead of re-shipping it from host every round
+        (the reference's DataLoader contract re-materializes client data per
+        round, fedavg_api.py:56-66 — on TPU that host->device hop dominates).
+        Returns (train_x, train_y, train_mask, train_counts) on device or
+        None when disabled/too large."""
+        c = self.config
+        if not self.supports_device_data or c.device_data == "off":
+            if c.device_data == "on" and not self.supports_device_data:
+                log.warning(
+                    "device_data='on' ignored: %s shards round inputs itself; "
+                    "using the host-slice path", type(self).__name__,
+                )
+            return None
+        if type(self).build_round_step is not FedAvgAPI.build_round_step:
+            # subclass rewired the round program (hierarchical/turboaggregate/
+            # ...); the gather wrapper only mirrors the base body
+            if c.device_data == "on":
+                log.warning(
+                    "device_data='on' ignored: %s overrides build_round_step, "
+                    "which the gather path cannot mirror; using the host-slice "
+                    "path", type(self).__name__,
+                )
+            return None
+        if c.device_data == "auto" and jax.default_backend() == "cpu":
+            # no host->device hop to avoid on CPU; a second in-RAM copy of the
+            # dataset would be pure cost ('on' still forces it, e.g. for tests)
+            return None
+        ds = self.dataset
+        x = ds.train_x
+        cast_bf16 = c.dtype == "bfloat16" and np.issubdtype(x.dtype, np.floating)
+        nbytes = (x.size * 2 if cast_bf16 else x.nbytes) + ds.train_y.nbytes
+        if c.device_data == "auto" and nbytes > c.device_data_max_bytes:
+            return None
+        if cast_bf16:
+            x = jnp.asarray(x, jnp.bfloat16)  # halves HBM + transfer cost
+        return (
+            jax.device_put(x),
+            jax.device_put(ds.train_y),
+            jax.device_put(ds.train_mask),
+            jax.device_put(jnp.asarray(ds.train_counts, jnp.float32)),
+        )
 
     # -- factory methods subclasses override ---------------------------------
 
@@ -83,21 +132,37 @@ class FedAvgAPI:
         Returns (new_variables, new_server_state); must be jit-pure."""
         return tree_weighted_mean(stacked_vars, counts), server_state
 
+    def _round_body(self, variables, server_state, cx, cy, cm, counts, rng):
+        res = jax.vmap(self._local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+            variables, cx, cy, cm, counts, jax.random.split(rng, cx.shape[0])
+        )
+        new_vars, new_state = self.aggregate(
+            variables, res.variables, counts, res, rng, server_state
+        )
+        train_loss = jnp.sum(res.train_loss * counts) / jnp.sum(counts)
+        return new_vars, new_state, train_loss
+
     def build_round_step(self):
-        local_train = self._local_train
-        aggregate = self.aggregate
+        body = self._round_body
 
         @jax.jit
         def round_step(variables, server_state, cx, cy, cm, counts, rng):
-            keys = jax.random.split(rng, cx.shape[0])
-            res = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
-                variables, cx, cy, cm, counts, keys
-            )
-            new_vars, new_state = aggregate(
-                variables, res.variables, counts, res, rng, server_state
-            )
-            train_loss = jnp.sum(res.train_loss * counts) / jnp.sum(counts)
-            return new_vars, new_state, train_loss
+            return body(variables, server_state, cx, cy, cm, counts, rng)
+
+        return round_step
+
+    def build_round_step_gather(self):
+        """Round step over device-resident data: the sampled cohort enters as
+        an index vector; the gather happens in HBM inside the same program."""
+        body = self._round_body
+
+        @jax.jit
+        def round_step(variables, server_state, tx, ty, tm, tcounts, idx, rng):
+            cx = jnp.take(tx, idx, axis=0)
+            cy = jnp.take(ty, idx, axis=0)
+            cm = jnp.take(tm, idx, axis=0)
+            counts = jnp.take(tcounts, idx, axis=0)
+            return body(variables, server_state, cx, cy, cm, counts, rng)
 
         return round_step
 
@@ -110,12 +175,18 @@ class FedAvgAPI:
                                  else c.client_num_in_total,
                                  min(c.client_num_per_round, self.dataset.num_clients),
                                  seed=c.seed)
-        cx, cy, cm, counts = self.dataset.client_slice(sampled)
         rk = round_key(self.root_key, round_idx)
-        self.variables, self.server_state, train_loss = self._round_step(
-            self.variables, self.server_state, cx, cy, cm,
-            jnp.asarray(counts, jnp.float32), rk
-        )
+        if self._dev_train is not None:
+            self.variables, self.server_state, train_loss = self._round_step_gather(
+                self.variables, self.server_state, *self._dev_train,
+                jnp.asarray(sampled, jnp.int32), rk
+            )
+        else:
+            cx, cy, cm, counts = self.dataset.client_slice(sampled)
+            self.variables, self.server_state, train_loss = self._round_step(
+                self.variables, self.server_state, cx, cy, cm,
+                jnp.asarray(counts, jnp.float32), rk
+            )
         return float(train_loss)
 
     def evaluate_global(self) -> dict:
@@ -160,6 +231,8 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
     The sampled cohort size must be a multiple of the mesh size; each device
     trains cohort/mesh_size clients per round under vmap.
     """
+
+    supports_device_data = False  # round inputs are sharded by place_round_inputs
 
     def __init__(self, dataset, config, bundle=None, mesh=None):
         from fedml_tpu.parallel.mesh import client_mesh

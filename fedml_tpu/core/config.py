@@ -81,6 +81,13 @@ class FedConfig:
     mesh_shape: tuple = ()           # e.g. (8,) client axis; () = auto
     dtype: str = "float32"           # compute dtype: float32 | bfloat16
     donate: bool = True
+    # Keep the full stacked client dataset resident in HBM and gather the
+    # sampled cohort ON DEVICE each round ("auto"|"on"|"off"). The reference
+    # re-ships the cohort host->device every round (its DataLoader contract);
+    # on TPU that transfer dominates the round (tunnel/PCIe bandwidth), so
+    # auto places train data on device whenever it fits the budget below.
+    device_data: str = "auto"
+    device_data_max_bytes: int = 6_000_000_000
 
     # observability
     run_name: str = "fedml_tpu"
@@ -96,6 +103,8 @@ class FedConfig:
             raise ValueError(f"unknown partition_method {self.partition_method!r}")
         if self.dtype not in ("float32", "bfloat16"):
             raise ValueError(f"dtype must be float32|bfloat16, got {self.dtype!r}")
+        if self.device_data not in ("auto", "on", "off"):
+            raise ValueError(f"device_data must be auto|on|off, got {self.device_data!r}")
         if self.ci:
             # CI fast path: shrink everything (reference fedavg_api.py:157-162).
             self.comm_round = min(self.comm_round, 2)
@@ -164,6 +173,10 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--seed", type=int, default=defaults.seed)
     p.add_argument("--ci", type=int, default=defaults.ci)
     p.add_argument("--dtype", type=str, default=defaults.dtype)
+    p.add_argument("--device_data", type=str, default=defaults.device_data,
+                   choices=("auto", "on", "off"))
+    p.add_argument("--device_data_max_bytes", type=int,
+                   default=defaults.device_data_max_bytes)
     p.add_argument("--run_name", type=str, default=defaults.run_name)
     p.add_argument("--config_yaml", type=str, default=None, help="optional YAML overriding flags")
     return p

@@ -106,10 +106,7 @@ def make_sp_lm_train_step(
     arrays get sharded P('dp', 'sp'); params replicated; grads psum over
     both axes.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     n_sp = mesh.shape["sp"]
 
@@ -145,19 +142,11 @@ def make_sp_lm_train_step(
 
     repl = P()
     sharded = P("dp", "sp")
-    import inspect
-
-    kw = {}
-    params = inspect.signature(shard_map).parameters
-    if "check_rep" in params:
-        kw["check_rep"] = False
-    elif "check_vma" in params:
-        kw["check_vma"] = False
     step = shard_map(
         local_step, mesh=mesh,
         in_specs=(repl, repl, sharded, sharded, sharded, repl),
         out_specs=(repl, repl, repl),
-        **kw,
+        check_vma=False,
     )
     jitted = jax.jit(step, donate_argnums=(0, 1))
 

@@ -103,7 +103,7 @@ class TestAggregation:
 
     def test_psum_weighted_average_on_mesh(self):
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         devices = np.array(jax.devices()[:4])
         mesh = Mesh(devices, ("c",))

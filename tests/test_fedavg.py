@@ -101,3 +101,51 @@ class TestSampling:
         a.train(); b.train()
         d = float(tree_global_norm(tree_sub(a.variables["params"], b.variables["params"])))
         assert d == 0.0
+
+
+class TestDeviceResidentData:
+    """The device-resident gather path (config.device_data) must produce
+    bit-identical rounds to the host-slice path — same gather, same RNG,
+    only the residency of the stacked arrays differs."""
+
+    def test_gather_path_matches_host_path(self):
+        ds = make_synthetic_classification(
+            "tiny-dev", (6,), 3, 6, records_per_client=12,
+            partition_method="hetero", partition_alpha=0.5, batch_size=4, seed=3,
+        )
+        kw = dict(
+            model="lr", dataset="tiny-dev", client_num_in_total=ds.num_clients,
+            client_num_per_round=3, comm_round=4, epochs=2, batch_size=4,
+            lr=0.3, momentum=0.9, frequency_of_the_test=100, seed=11,
+        )
+        on = FedAvgAPI(ds, FedConfig(device_data="on", **kw))
+        off = FedAvgAPI(ds, FedConfig(device_data="off", **kw))
+        assert on._dev_train is not None
+        assert off._dev_train is None
+        for r in range(4):
+            l_on = on.run_round(r)
+            l_off = off.run_round(r)
+            assert np.isclose(l_on, l_off, rtol=1e-6), (r, l_on, l_off)
+        for a, b in zip(
+            jax.tree.leaves(on.variables), jax.tree.leaves(off.variables)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+    def test_auto_respects_budget_and_platform(self):
+        ds = _tiny_dataset()
+        kw = dict(
+            model="lr", dataset="tiny", client_num_in_total=ds.num_clients,
+            client_num_per_round=2, comm_round=1, batch_size=8, lr=0.1, seed=0,
+        )
+        auto = FedAvgAPI(ds, FedConfig(device_data="auto", **kw))
+        if jax.default_backend() == "cpu":
+            # no transfer to avoid on CPU: auto declines the duplicate copy
+            assert auto._dev_train is None
+        else:
+            assert auto._dev_train is not None
+        forced = FedAvgAPI(ds, FedConfig(device_data="on", **kw))
+        assert forced._dev_train is not None  # 'on' overrides the heuristic
+        capped = FedAvgAPI(
+            ds, FedConfig(device_data="on", device_data_max_bytes=1, **kw)
+        )
+        assert capped._dev_train is not None  # budget only gates 'auto'

@@ -147,7 +147,7 @@ class TestXent:
 
 class TestRingAttention:
     def test_ring_matches_single_device(self):
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from fedml_tpu.parallel.mesh import client_mesh
@@ -165,14 +165,14 @@ class TestRingAttention:
         ring = shard_map(
             local, mesh=mesh,
             in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
-            out_specs=P(None, None, "sp"), check_rep=False,
+            out_specs=P(None, None, "sp"), check_vma=False,
         )
         out = jax.jit(ring)(q, k, v)
         ref = naive_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
     def test_ring_grads_match_single_device(self):
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from fedml_tpu.parallel.mesh import client_mesh
@@ -189,7 +189,7 @@ class TestRingAttention:
             out = shard_map(
                 local, mesh=mesh,
                 in_specs=(P(None, None, "sp"),) * 3,
-                out_specs=P(None, None, "sp"), check_rep=False)(q, k, v)
+                out_specs=P(None, None, "sp"), check_vma=False)(q, k, v)
             return jnp.sum(out ** 2)
 
         def ref_loss(q, k, v):
