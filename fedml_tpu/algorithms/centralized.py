@@ -86,11 +86,12 @@ class StreamingCentralizedTrainer:
     the Python interpreter for batch assembly."""
 
     def __init__(self, dataset: FedDataset, config: FedConfig, bundle: ModelBundle | None = None,
-                 n_threads: int = 4, depth: int = 6):
+                 n_threads: int = 4, depth: int = 6, mesh=None):
         from fedml_tpu.parallel.local import make_optimizer
 
         self.dataset = dataset
         self.config = config
+        self.mesh = mesh  # optional ('batch',) mesh: batch-sharded DP + sync-BN
         self.bundle = bundle or create_model(
             config.model, dataset.class_num, input_shape=dataset.train_x.shape[2:] or None
         )
@@ -103,29 +104,33 @@ class StreamingCentralizedTrainer:
         self.x, self.y = x[keep], y[keep]
         self.tx = make_optimizer(config.client_optimizer, config.lr, config.momentum, config.wd)
         self.opt_state = self.tx.init(self.variables["params"])
-        bundle, task, tx, clip = self.bundle, self.task, self.tx, config.grad_clip
 
-        def step(variables, opt_state, bx, by, key):
-            import optax
+        # One step builder for both paths: mesh=None compiles the plain
+        # donated single-device step; a ('batch',) mesh adds GSPMD batch
+        # sharding + sync-BN + grad all-reduce (nn.DataParallel counterpart,
+        # GKTServerTrainer.py:28-29).
+        from fedml_tpu.parallel.dataparallel import make_dp_train_step
 
-            def loss_fn(params):
-                v = dict(variables)
-                v["params"] = params
-                logits, new_vars = bundle.apply_train(v, bx, key)
-                m = jnp.ones(by.shape[0], jnp.float32)
-                return task.loss(logits, by, m), new_vars
+        dp = make_dp_train_step(self.bundle, self.task, self.tx, self.mesh,
+                                grad_clip=config.grad_clip)
 
-            (loss, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(variables["params"])
-            new_vars = dict(new_vars)
-            if clip:
-                gnorm = optax.global_norm(grads)
-                scale = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
-                grads = jax.tree.map(lambda g: g * scale, grads)
-            ups, opt_state = tx.update(grads, opt_state, variables["params"])
-            new_vars["params"] = optax.apply_updates(variables["params"], ups)
-            return new_vars, opt_state, loss
+        # drop_last=True fixes the batch size, so the all-ones mask is one
+        # constant made (and, on a mesh, sharded) once — not per step
+        ones_mask = jnp.ones(config.batch_size, jnp.float32)
+        if self.mesh is not None:
+            from fedml_tpu.parallel.dataparallel import place_batch
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+            ones_mask = place_batch(self.mesh, ones_mask)
+
+            def step(variables, opt_state, bx, by, key):
+                # pipeline batches arrive committed to one device; respread
+                bx, by = place_batch(self.mesh, bx, by)
+                return dp(variables, opt_state, bx, by, ones_mask, key)
+        else:
+            def step(variables, opt_state, bx, by, key):
+                return dp(variables, opt_state, bx, by, ones_mask, key)
+
+        self._step = step
         self._eval = make_eval_fn(self.bundle, self.task)
 
     def train(self) -> dict:

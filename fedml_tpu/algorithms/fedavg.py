@@ -99,7 +99,8 @@ class FedAvgAPI:
         ds = self.dataset
         x = ds.train_x
         cast_bf16 = c.dtype == "bfloat16" and np.issubdtype(x.dtype, np.floating)
-        nbytes = (x.size * 2 if cast_bf16 else x.nbytes) + ds.train_y.nbytes
+        nbytes = ((x.size * 2 if cast_bf16 else x.nbytes) + ds.train_y.nbytes
+                  + ds.train_mask.nbytes + ds.train_counts.nbytes)
         if c.device_data == "auto" and nbytes > c.device_data_max_bytes:
             return None
         if cast_bf16:

@@ -85,9 +85,15 @@ class FedGKTAPI:
         pair: Optional[GKTPair] = None,
         client_blocks: int = 3,
         server_blocks_per_stage: int = 9,
+        server_mesh=None,
     ):
         self.dataset = dataset
         self.config = config
+        # optional ('batch',) mesh for the server phase — the TPU counterpart
+        # of the reference's nn.DataParallel 4-GPU server
+        # (GKTServerTrainer.py:28-29): GSPMD shards the feature batches and
+        # all-reduces grads/BN moments; results match single-device exactly
+        self.server_mesh = server_mesh
         input_shape = tuple(dataset.train_x.shape[2:])
         self.pair = pair or create_gkt_pair(
             dataset.class_num,
@@ -315,6 +321,18 @@ class FedGKTAPI:
             new_slogits = out.reshape((C, n_pad, out.shape[-1]))
             return svars, sopt, new_slogits, ep_losses[-1]
 
+        if self.server_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self.server_mesh
+            axis = mesh.axis_names[0]
+            repl = NamedSharding(mesh, P())
+            shard = NamedSharding(mesh, P(axis))  # client axis of the stacks
+            return jax.jit(
+                server_phase,
+                in_shardings=(repl, repl, shard, shard, shard, shard, repl),
+                out_shardings=(repl, repl, shard, repl),
+            )
         return jax.jit(server_phase)
 
     # ----------------------------------------------------------------- eval

@@ -24,11 +24,13 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: Any = jnp.float32
+    bn_axis: Any = None  # mapped-axis name for cross-device sync-BN
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       dtype=self.dtype, axis_name=self.bn_axis)
         residual = x
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding="SAME")(x)
         y = nn.relu(norm()(y))
@@ -46,51 +48,54 @@ class CifarResNet(nn.Module):
     blocks_per_stage: int
     output_dim: int = 10
     dtype: Any = jnp.float32
+    bn_axis: Any = None  # sync-BN over this mapped axis (batchnorm_utils.py counterpart)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=self.dtype)(x))
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype, axis_name=self.bn_axis)(x))
         for stage, filters in enumerate((16, 32, 64)):
             for block in range(self.blocks_per_stage):
                 strides = 2 if stage > 0 and block == 0 else 1
-                x = BasicBlock(filters, strides, dtype=self.dtype)(x, train=train)
+                x = BasicBlock(filters, strides, dtype=self.dtype,
+                               bn_axis=self.bn_axis)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
 
 
-def _make(depth: int, output_dim: int, dtype=jnp.float32) -> CifarResNet:
+def _make(depth: int, output_dim: int, dtype=jnp.float32, bn_axis=None) -> CifarResNet:
     assert (depth - 2) % 6 == 0, "CIFAR ResNet depth must be 6n+2"
-    return CifarResNet((depth - 2) // 6, output_dim, dtype=dtype)
+    return CifarResNet((depth - 2) // 6, output_dim, dtype=dtype, bn_axis=bn_axis)
 
 
 @register_model("resnet56")
-def _resnet56(output_dim: int, dtype=jnp.float32, **_):
+def _resnet56(output_dim: int, dtype=jnp.float32, bn_axis=None, **_):
     return ModelBundle(
         name="resnet56",
-        module=_make(56, output_dim, dtype),
+        module=_make(56, output_dim, dtype, bn_axis),
         input_shape=(32, 32, 3),
         has_batch_stats=True,
     )
 
 
 @register_model("resnet110")
-def _resnet110(output_dim: int, dtype=jnp.float32, **_):
+def _resnet110(output_dim: int, dtype=jnp.float32, bn_axis=None, **_):
     return ModelBundle(
         name="resnet110",
-        module=_make(110, output_dim, dtype),
+        module=_make(110, output_dim, dtype, bn_axis),
         input_shape=(32, 32, 3),
         has_batch_stats=True,
     )
 
 
 @register_model("resnet20")
-def _resnet20(output_dim: int, dtype=jnp.float32, **_):
+def _resnet20(output_dim: int, dtype=jnp.float32, bn_axis=None, **_):
     """Small variant for CI/tests (not in the reference zoo but same family)."""
     return ModelBundle(
         name="resnet20",
-        module=_make(20, output_dim, dtype),
+        module=_make(20, output_dim, dtype, bn_axis),
         input_shape=(32, 32, 3),
         has_batch_stats=True,
     )

@@ -1,0 +1,133 @@
+"""Batch-sharded (intra-node data-parallel) training with synchronized
+BatchNorm.
+
+Counterpart of the reference's two intra-node DP mechanisms:
+
+- ``nn.DataParallel`` over 4 GPUs for the FedGKT server model
+  (fedml_api/distributed/fedgkt/GKTServerTrainer.py:28-29), and
+- the sync-BN helpers shipped for segmentation
+  (fedml_api/model/cv/batchnorm_utils.py, ~462 LoC of hand-rolled
+  cross-GPU mean/var broadcast + replicate/gather plumbing).
+
+On TPU neither needs a subsystem, because GSPMD already is one. The train
+step is written exactly like the single-device step — global batch, global
+mean loss, BatchNorm over the whole batch — and ``jit`` with
+``in_shardings`` placing the batch axis over a 1-D ``('batch',)`` mesh
+partitions it: XLA shards the convolutions, turns BatchNorm's batch
+moments into cross-device all-reduces (sync-BN for free — the whole
+batchnorm_utils file dissolves into the partitioner), and all-reduces the
+gradients. Parameters and optimizer state are replicated. The result is
+bit-comparable to running the same step on one device with the full batch
+(tests/test_dataparallel.py asserts it).
+
+Models that need sync-BN under EXPLICIT shard_map code instead (where
+each program instance only sees its shard) accept ``bn_axis=<axis name>``
+(e.g. resnet.py), which flax wires to a psum of the batch moments. The
+federated paths deliberately do NOT use it: in cross-silo training each
+device holds a different client whose BN must stay local.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.core.tasks import Task
+from fedml_tpu.models import ModelBundle
+
+BATCH_AXIS = "batch"
+
+
+def batch_mesh(n_devices: Optional[int] = None, axis: str = BATCH_AXIS) -> Mesh:
+    """1-D mesh over the batch axis (all local devices by default)."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def make_dp_train_step(
+    bundle: ModelBundle,
+    task: Task,
+    tx: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    axis: str = BATCH_AXIS,
+    compute_dtype=None,
+    grad_clip: Optional[float] = None,
+) -> Callable:
+    """Build ``step(variables, opt_state, x, y, mask, rng) -> (variables,
+    opt_state, loss)``; with a ``mesh`` the global batch is sharded over it.
+
+    The body is the plain single-device step; GSPMD distributes it when a
+    mesh is given (``mesh=None`` compiles the same body unsharded, so one
+    builder serves both the single-chip and data-parallel paths). BN
+    stats, the mask-weighted mean loss, and gradients are all global by
+    construction. Shard-degenerate batches are fine (the mask handles
+    ragged tails); the batch size should be a multiple of the mesh size
+    for an even split. Params/opt state are donated each step.
+    """
+
+    def step(variables, opt_state, x, y, mask, rng):
+        if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(compute_dtype)
+
+        def loss_fn(p):
+            vars_in = dict(variables)
+            vars_in["params"] = p
+            logits, new_vars = bundle.apply_train(vars_in, x, rng)
+            return task.loss(logits, y, mask), new_vars
+
+        (loss, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            variables["params"]
+        )
+        if grad_clip:
+            gnorm = optax.global_norm(grads)
+            scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, new_opt_state = tx.update(grads, opt_state, variables["params"])
+        params = optax.apply_updates(variables["params"], updates)
+        out_vars = dict(new_vars)
+        out_vars["params"] = params
+        return out_vars, new_opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(axis))
+    return jax.jit(
+        step,
+        in_shardings=(repl, repl, shard, shard, shard, repl),
+        out_shardings=(repl, repl, repl),
+        donate_argnums=(0, 1),
+    )
+
+
+def make_dp_eval_fn(
+    bundle: ModelBundle,
+    task: Task,
+    mesh: Mesh,
+    axis: str = BATCH_AXIS,
+) -> Callable:
+    """Build ``evaluate(variables, x, y, mask) -> metric-sum dict`` with the
+    eval pool sharded over the mesh; sums are global."""
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(axis))
+
+    def ev(variables, x, y, mask):
+        logits = bundle.apply_eval(variables, x)
+        return task.metrics(logits, y, mask)
+
+    return jax.jit(ev, in_shardings=(repl, shard, shard, shard), out_shardings=repl)
+
+
+def place_batch(mesh: Mesh, *arrays, axis: str = BATCH_AXIS):
+    """device_put arrays with their leading (batch) axis sharded."""
+    shard = NamedSharding(mesh, P(axis))
+    out = tuple(jax.device_put(a, shard) for a in arrays)
+    return out if len(out) > 1 else out[0]
