@@ -190,6 +190,31 @@ class FedAvgAPI:
             )
         return float(train_loss)
 
+    def save(self, path: str, round_idx: int = 0, orbax: bool = False) -> None:
+        """Checkpoint variables + server state (+ resume round). The
+        reference cannot do this at all (SURVEY.md §5.4: duck-typed
+        save_model, no resume); ``orbax=True`` writes a sharded checkpoint."""
+        from fedml_tpu.utils import checkpoint as ckpt
+
+        if orbax:
+            ckpt.save_checkpoint_orbax(path, self.variables, self.server_state, round_idx)
+        else:
+            ckpt.save_checkpoint(path, jax.tree.map(np.asarray, self.variables),
+                                 jax.tree.map(np.asarray, self.server_state),
+                                 round_idx)
+
+    def restore(self, path: str, orbax: bool = False) -> int:
+        """Load a checkpoint into this API; returns the round index to
+        resume from. Training continued from here is identical to an
+        uninterrupted run (per-round RNG is derived from round_idx)."""
+        from fedml_tpu.utils import checkpoint as ckpt
+
+        state = (ckpt.load_checkpoint_orbax(path) if orbax
+                 else ckpt.load_checkpoint(path))
+        self.variables = jax.tree.map(jnp.asarray, state["variables"])
+        self.server_state = jax.tree.map(jnp.asarray, state["server_state"])
+        return int(state["round_idx"])
+
     def evaluate_global(self) -> dict:
         sums = self._eval(
             self.variables, self.dataset.test_x, self.dataset.test_y, self.dataset.test_mask
@@ -202,7 +227,11 @@ class FedAvgAPI:
         c = self.config
         timer = RoundTimer()
         logger = MetricsLogger(c.run_name, c.enable_wandb, config=c.to_dict())
-        for r in range(c.comm_round):
+        start_round = 0
+        if c.resume_from:
+            start_round = self.restore(c.resume_from)
+            log.info("resumed from %s at round %d", c.resume_from, start_round)
+        for r in range(start_round, c.comm_round):
             with timer.phase("train"):
                 loss = self.run_round(r)
             timer.tick_round()
@@ -216,6 +245,12 @@ class FedAvgAPI:
                     {"Train/Loss": loss, "Test/Acc": m.get("acc"),
                      "Test/Loss": m.get("loss")}, r,
                 )
+            if c.checkpoint_dir and (
+                (r + 1) % c.checkpoint_frequency == 0 or r == c.comm_round - 1
+            ):
+                import os
+
+                self.save(os.path.join(c.checkpoint_dir, "latest.ckpt"), r + 1)
         timing = timer.summary()
         self.history["rounds_per_sec"] = timing["rounds_per_sec"]
         self.history["timing"] = timing

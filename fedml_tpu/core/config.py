@@ -93,6 +93,11 @@ class FedConfig:
     run_name: str = "fedml_tpu"
     enable_wandb: bool = False
 
+    # checkpoint/resume (absent in the reference, SURVEY.md §5.4)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_frequency: int = 10   # rounds between checkpoints when dir set
+    resume_from: Optional[str] = None
+
     def __post_init__(self):
         if self.client_num_per_round > self.client_num_in_total:
             raise ValueError(
@@ -105,6 +110,10 @@ class FedConfig:
             raise ValueError(f"dtype must be float32|bfloat16, got {self.dtype!r}")
         if self.device_data not in ("auto", "on", "off"):
             raise ValueError(f"device_data must be auto|on|off, got {self.device_data!r}")
+        if self.checkpoint_frequency < 1:
+            raise ValueError(
+                f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"
+            )
         if self.ci:
             # CI fast path: shrink everything (reference fedavg_api.py:157-162).
             self.comm_round = min(self.comm_round, 2)
@@ -178,6 +187,9 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--device_data_max_bytes", type=int,
                    default=defaults.device_data_max_bytes)
     p.add_argument("--run_name", type=str, default=defaults.run_name)
+    p.add_argument("--checkpoint_dir", type=str, default=None)
+    p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)
+    p.add_argument("--resume_from", type=str, default=None)
     p.add_argument("--config_yaml", type=str, default=None, help="optional YAML overriding flags")
     return p
 

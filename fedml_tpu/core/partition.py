@@ -132,6 +132,51 @@ def hetero_partition(
     )
 
 
+def hetero_fix_partition(
+    labels: np.ndarray,
+    client_num: int,
+    classes: int,
+    alpha: float,
+    map_path: str,
+    seed: int = 0,
+) -> dict[int, np.ndarray]:
+    """'hetero-fix': a PRECOMPUTED partition map file so every run (and every
+    rank) sees the identical non-IID split (reference
+    cifar10/data_loader.py:150-158 reads distribution/net_dataidx_map text
+    files shipped with the repo). Here the map is a .npz of per-client index
+    arrays; when the file doesn't exist yet it is generated once with the
+    Dirichlet machinery and saved, so the first run fixes the split for all
+    later runs."""
+    import os
+
+    if os.path.exists(map_path):
+        with np.load(map_path) as z:
+            m = {int(k.split("_", 1)[1]): z[k] for k in z.files}
+        if len(m) != client_num:
+            raise ValueError(
+                f"partition map {map_path!r} has {len(m)} clients, expected "
+                f"{client_num}; delete it to regenerate"
+            )
+        # a stale map from a different dataset snapshot must not silently
+        # mis-partition: it must cover exactly the current records
+        allidx = np.concatenate([m[i] for i in range(client_num)])
+        if len(allidx) != len(labels) or (
+            len(allidx) and int(allidx.max()) >= len(labels)
+        ):
+            raise ValueError(
+                f"partition map {map_path!r} covers {len(allidx)} records "
+                f"(max index {int(allidx.max()) if len(allidx) else -1}) but "
+                f"the dataset has {len(labels)}; delete it to regenerate"
+            )
+        return {i: m[i].astype(np.int64) for i in range(client_num)}
+    m = hetero_partition(labels, client_num, classes, alpha, seed=seed)
+    os.makedirs(os.path.dirname(map_path) or ".", exist_ok=True)
+    tmp = map_path + ".tmp.npz"
+    np.savez(tmp, **{f"client_{i}": v for i, v in m.items()})
+    os.replace(tmp, map_path)
+    return m
+
+
 def partition(
     method: str,
     labels: np.ndarray,
@@ -139,13 +184,20 @@ def partition(
     classes: int,
     alpha: Optional[float] = None,
     seed: int = 0,
+    map_path: Optional[str] = None,
 ) -> dict[int, np.ndarray]:
     """Dispatch on the reference's --partition_method flag values
-    (homo | hetero); 'hetero-fix' (precomputed maps) is handled by loaders."""
+    (homo | hetero | hetero-fix)."""
     if method == "homo":
         return homo_partition(len(labels), client_num, seed=seed)
     if method == "hetero":
         if alpha is None:
             raise ValueError("hetero partition requires alpha (--partition_alpha)")
         return hetero_partition(labels, client_num, classes, alpha, seed=seed)
+    if method == "hetero-fix":
+        if alpha is None:
+            raise ValueError("hetero-fix partition requires alpha for first-run generation")
+        if map_path is None:
+            raise ValueError("hetero-fix partition requires a map_path")
+        return hetero_fix_partition(labels, client_num, classes, alpha, map_path, seed=seed)
     raise ValueError(f"unknown partition method: {method!r}")

@@ -64,6 +64,7 @@ def _normalize(u8: np.ndarray) -> np.ndarray:
 def _build(
     name: str, loaded, classes: int, client_num_in_total: int,
     partition_method: str, partition_alpha: float, batch_size: int, seed: int,
+    data_dir: str = "./data",
 ) -> FedDataset:
     if loaded is None:
         return make_synthetic_classification(
@@ -73,8 +74,15 @@ def _build(
         )
     x, y, test_x, test_y = loaded
     x, test_x = _normalize(x), _normalize(test_x)
+    import os
+
     idx_map = partition_fn(
-        partition_method, y, client_num_in_total, classes, partition_alpha, seed=seed
+        partition_method, y, client_num_in_total, classes, partition_alpha,
+        seed=seed,
+        # hetero-fix: the precomputed-map file lives next to the data
+        # (reference ships distribution/net_dataidx_map files,
+        # cifar10/data_loader.py:150-158)
+        map_path=os.path.join(data_dir, f"{name}_partition_{client_num_in_total}.npz"),
     )
     xs = [x[idx_map[i]] for i in range(client_num_in_total)]
     ys = [y[idx_map[i]].astype(np.int32) for i in range(client_num_in_total)]
@@ -93,7 +101,7 @@ def load_cifar10(
     batch_size: int = 64, seed: int = 0, **_,
 ) -> FedDataset:
     return _build("cifar10", _load_cifar10_files(data_dir), 10, client_num_in_total,
-                  partition_method, partition_alpha, batch_size, seed)
+                  partition_method, partition_alpha, batch_size, seed, data_dir)
 
 
 @register_dataset("cifar100")
@@ -103,7 +111,7 @@ def load_cifar100(
     batch_size: int = 64, seed: int = 0, **_,
 ) -> FedDataset:
     return _build("cifar100", _load_cifar100_files(data_dir), 100, client_num_in_total,
-                  partition_method, partition_alpha, batch_size, seed)
+                  partition_method, partition_alpha, batch_size, seed, data_dir)
 
 
 @register_dataset("cinic10")
@@ -115,4 +123,4 @@ def load_cinic10(
     # CINIC-10 ships as an image folder tree; without it we use the synthetic
     # stand-in (same 10 classes / 32x32x3).
     return _build("cinic10", None, 10, client_num_in_total,
-                  partition_method, partition_alpha, batch_size, seed)
+                  partition_method, partition_alpha, batch_size, seed, data_dir)

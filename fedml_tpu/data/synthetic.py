@@ -87,6 +87,7 @@ def make_synthetic_classification(
     dtype=np.float32,
     integer_inputs: bool = False,
     vocab: int = 0,
+    data_dir: str = "./data",
 ) -> FedDataset:
     """Learnable stand-in with the same shapes/partition semantics as the real
     dataset (used when the files aren't on disk — this image has no egress).
@@ -110,8 +111,12 @@ def make_synthetic_classification(
         x = x.reshape((n_total,) + tuple(input_shape))
     train_x, train_y = x[:-test_records], y[:-test_records]
     test_x, test_y = x[-test_records:], y[-test_records:]
+    import os
+
     idx_map = partition_fn(
-        partition_method, train_y, num_clients, classes, partition_alpha, seed=seed
+        partition_method, train_y, num_clients, classes, partition_alpha,
+        seed=seed,
+        map_path=os.path.join(data_dir, f"{name}_partition_{num_clients}.npz"),
     )
     xs = [train_x[idx_map[i]] for i in range(num_clients)]
     ys = [train_y[idx_map[i]] for i in range(num_clients)]
