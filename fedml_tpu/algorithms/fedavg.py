@@ -209,8 +209,14 @@ class FedAvgAPI:
         uninterrupted run (per-round RNG is derived from round_idx)."""
         from fedml_tpu.utils import checkpoint as ckpt
 
-        state = (ckpt.load_checkpoint_orbax(path) if orbax
-                 else ckpt.load_checkpoint(path))
+        if orbax:
+            # the live state is the restore template: orbax rebuilds optax
+            # namedtuples (and shardings) only when given the matching pytree
+            state = ckpt.load_checkpoint_orbax(
+                path, template={"variables": self.variables,
+                                "server_state": self.server_state})
+        else:
+            state = ckpt.load_checkpoint(path)
         self.variables = jax.tree.map(jnp.asarray, state["variables"])
         self.server_state = jax.tree.map(jnp.asarray, state["server_state"])
         return int(state["round_idx"])

@@ -71,6 +71,7 @@ def _build(
             f"{name}(synthetic)", (32, 32, 3), classes, client_num_in_total,
             records_per_client=160, partition_method=partition_method,
             partition_alpha=partition_alpha, batch_size=batch_size, seed=seed,
+            data_dir=data_dir,
         )
     x, y, test_x, test_y = loaded
     x, test_x = _normalize(x), _normalize(test_x)
@@ -82,7 +83,11 @@ def _build(
         # hetero-fix: the precomputed-map file lives next to the data
         # (reference ships distribution/net_dataidx_map files,
         # cifar10/data_loader.py:150-158)
-        map_path=os.path.join(data_dir, f"{name}_partition_{client_num_in_total}.npz"),
+        # alpha is a semantic parameter of the split — a map for one alpha
+        # must never be silently reused for another
+        map_path=os.path.join(
+            data_dir,
+            f"{name}_partition_{client_num_in_total}_a{partition_alpha}.npz"),
     )
     xs = [x[idx_map[i]] for i in range(client_num_in_total)]
     ys = [y[idx_map[i]].astype(np.int32) for i in range(client_num_in_total)]

@@ -116,7 +116,11 @@ def make_synthetic_classification(
     idx_map = partition_fn(
         partition_method, train_y, num_clients, classes, partition_alpha,
         seed=seed,
-        map_path=os.path.join(data_dir, f"{name}_partition_{num_clients}.npz"),
+        # synthetic labels depend on the seed, so the fixed map is keyed on
+        # alpha AND seed (a real dataset's labels are seed-independent)
+        map_path=os.path.join(
+            data_dir,
+            f"{name}_partition_{num_clients}_a{partition_alpha}_s{seed}.npz"),
     )
     xs = [train_x[idx_map[i]] for i in range(num_clients)]
     ys = [train_y[idx_map[i]] for i in range(num_clients)]

@@ -31,6 +31,7 @@ class SelfAttention(nn.Module):
     attn_impl: str = "auto"
     ring_axis: Optional[str] = None
     ring_size: int = 1
+    sp_mode: str = "ring"            # ring | ulysses (all-to-all)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -45,11 +46,11 @@ class SelfAttention(nn.Module):
 
         q, k, v = heads_first(q), heads_first(k), heads_first(v)
         if self.ring_axis is not None and self.ring_size > 1:
-            from fedml_tpu.parallel.sequence import ring_attention
+            from fedml_tpu.parallel.sequence import sequence_attention
 
-            o = ring_attention(q, k, v, axis_name=self.ring_axis,
-                               axis_size=self.ring_size, causal=True,
-                               impl=self.attn_impl)
+            o = sequence_attention(q, k, v, axis_name=self.ring_axis,
+                                   axis_size=self.ring_size, causal=True,
+                                   impl=self.attn_impl, mode=self.sp_mode)
         else:
             o = attention(q, k, v, causal=True, impl=self.attn_impl)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.dim)
@@ -64,12 +65,14 @@ class Block(nn.Module):
     attn_impl: str = "auto"
     ring_axis: Optional[str] = None
     ring_size: int = 1
+    sp_mode: str = "ring"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, h, train: bool):
         a = SelfAttention(self.dim, self.heads, self.attn_impl,
-                          self.ring_axis, self.ring_size, self.dtype,
+                          self.ring_axis, self.ring_size, self.sp_mode,
+                          self.dtype,
                           name="attn")(nn.LayerNorm(dtype=self.dtype)(h))
         if self.dropout:
             a = nn.Dropout(self.dropout, deterministic=not train)(a)
@@ -94,6 +97,7 @@ class TransformerLM(nn.Module):
     attn_impl: str = "auto"
     ring_axis: Optional[str] = None     # set to 'sp' for sequence parallelism
     ring_size: int = 1
+    sp_mode: str = "ring"               # ring (ppermute) | ulysses (all-to-all)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -107,7 +111,7 @@ class TransformerLM(nn.Module):
         for i in range(self.layers):
             h = Block(self.dim, self.heads, self.mlp_ratio, self.dropout,
                       self.attn_impl, self.ring_axis, self.ring_size,
-                      self.dtype, name=f"block{i}")(h, train)
+                      self.sp_mode, self.dtype, name=f"block{i}")(h, train)
         h = nn.LayerNorm(dtype=self.dtype)(h)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(h)
 
@@ -120,6 +124,7 @@ def _bundle(name, vocab, seq_len, **kw):
                            attn_impl=kw.pop("attn_impl", "auto"),
                            ring_axis=kw.pop("ring_axis", None),
                            ring_size=kw.pop("ring_size", 1),
+                           sp_mode=kw.pop("sp_mode", "ring"),
                            dtype=kw.pop("dtype", jnp.float32), **sizes)
     return ModelBundle(
         name=name, module=module, input_shape=(seq_len,),
