@@ -140,7 +140,15 @@ class FedAvgAPI:
         new_vars, new_state = self.aggregate(
             variables, res.variables, counts, res, rng, server_state
         )
-        train_loss = jnp.sum(res.train_loss * counts) / jnp.sum(counts)
+        # elastic rounds: failed clients enter with count 0 and drop out of
+        # the weighted mean; an all-failed round is a full no-op — weights
+        # AND server state (FedOpt moments etc.) roll back, else the server
+        # optimizer would absorb the garbage zero-aggregate pseudo-gradient
+        total = jnp.sum(counts)
+        keep = total > 0
+        new_vars = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_vars, variables)
+        new_state = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_state, server_state)
+        train_loss = jnp.sum(res.train_loss * counts) / jnp.maximum(total, 1e-12)
         return new_vars, new_state, train_loss
 
     def build_round_step(self):
@@ -154,18 +162,48 @@ class FedAvgAPI:
 
     def build_round_step_gather(self):
         """Round step over device-resident data: the sampled cohort enters as
-        an index vector; the gather happens in HBM inside the same program."""
+        an index vector; the gather happens in HBM inside the same program.
+        ``live`` [cohort] zeroes failed clients' weights (elastic rounds)."""
         body = self._round_body
 
         @jax.jit
-        def round_step(variables, server_state, tx, ty, tm, tcounts, idx, rng):
+        def round_step(variables, server_state, tx, ty, tm, tcounts, idx, live, rng):
             cx = jnp.take(tx, idx, axis=0)
             cy = jnp.take(ty, idx, axis=0)
             cm = jnp.take(tm, idx, axis=0)
-            counts = jnp.take(tcounts, idx, axis=0)
+            counts = jnp.take(tcounts, idx, axis=0) * live
             return body(variables, server_state, cx, cy, cm, counts, rng)
 
         return round_step
+
+    def _sample_failures(self, round_idx: int, cohort: int) -> Optional[np.ndarray]:
+        """Deterministic per-round fault injection (SURVEY.md §5.3: the
+        reference has NO failure detection or fault injection — its only
+        failure handling is MPI.Abort). With ``config.failure_prob`` > 0
+        each sampled client independently fails this round; the aggregation
+        then runs elastically over the survivors. Returns a {0,1} live
+        vector or None when injection is off."""
+        p = self.config.failure_prob
+        if not p:
+            return None
+        elastic_ok = (type(self).build_round_step is FedAvgAPI.build_round_step
+                      or getattr(type(self), "elastic_rounds_ok", False))
+        if not elastic_ok:
+            if not getattr(self, "_warned_no_elastic", False):
+                log.warning(
+                    "failure_prob=%s ignored: %s rewires the round program "
+                    "without an elastic (zero-weight) aggregation guard",
+                    p, type(self).__name__)
+                self._warned_no_elastic = True
+            return None
+        rng = np.random.default_rng([self.config.seed, 0x0F41, round_idx])
+        live = (rng.random(cohort) >= p).astype(np.float32)
+        n_failed = int(cohort - live.sum())
+        if n_failed:
+            log.info("round %d: %d/%d clients failed (injected)",
+                     round_idx, n_failed, cohort)
+        self.history.setdefault("failed_clients", []).append(n_failed)
+        return live
 
     # -- driver --------------------------------------------------------------
 
@@ -177,13 +215,19 @@ class FedAvgAPI:
                                  min(c.client_num_per_round, self.dataset.num_clients),
                                  seed=c.seed)
         rk = round_key(self.root_key, round_idx)
+        live = self._sample_failures(round_idx, len(sampled))
         if self._dev_train is not None:
+            live_v = (jnp.ones((len(sampled),), jnp.float32) if live is None
+                      else jnp.asarray(live))
             self.variables, self.server_state, train_loss = self._round_step_gather(
                 self.variables, self.server_state, *self._dev_train,
-                jnp.asarray(sampled, jnp.int32), rk
+                jnp.asarray(sampled, jnp.int32), live_v, rk
             )
         else:
             cx, cy, cm, counts = self.dataset.client_slice(sampled)
+            counts = np.asarray(counts, np.float32)
+            if live is not None:
+                counts = counts * live
             self.variables, self.server_state, train_loss = self._round_step(
                 self.variables, self.server_state, cx, cy, cm,
                 jnp.asarray(counts, jnp.float32), rk
@@ -237,6 +281,19 @@ class FedAvgAPI:
         if c.resume_from:
             start_round = self.restore(c.resume_from)
             log.info("resumed from %s at round %d", c.resume_from, start_round)
+        from fedml_tpu.utils.metrics import profile_trace
+
+        with profile_trace(c.profile_dir):
+            self._train_rounds(start_round, timer, logger)
+        timing = timer.summary()
+        self.history["rounds_per_sec"] = timing["rounds_per_sec"]
+        self.history["timing"] = timing
+        self.metrics_logger = logger
+        logger.close()
+        return self.history
+
+    def _train_rounds(self, start_round, timer, logger):
+        c = self.config
         for r in range(start_round, c.comm_round):
             with timer.phase("train"):
                 loss = self.run_round(r)
@@ -257,12 +314,6 @@ class FedAvgAPI:
                 import os
 
                 self.save(os.path.join(c.checkpoint_dir, "latest.ckpt"), r + 1)
-        timing = timer.summary()
-        self.history["rounds_per_sec"] = timing["rounds_per_sec"]
-        self.history["timing"] = timing
-        self.metrics_logger = logger
-        logger.close()
-        return self.history
 
 
 class CrossSiloFedAvgAPI(FedAvgAPI):
@@ -275,6 +326,7 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
     """
 
     supports_device_data = False  # round inputs are sharded by place_round_inputs
+    elastic_rounds_ok = True      # the psum path guards zero total weight
 
     def __init__(self, dataset, config, bundle=None, mesh=None):
         from fedml_tpu.parallel.mesh import client_mesh

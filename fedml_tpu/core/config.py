@@ -98,6 +98,12 @@ class FedConfig:
     checkpoint_frequency: int = 10   # rounds between checkpoints when dir set
     resume_from: Optional[str] = None
 
+    # failure injection / elastic rounds (SURVEY.md §5.3: reference has none)
+    failure_prob: float = 0.0        # P(sampled client fails a round)
+
+    # jax profiler (SURVEY.md §5.1): device traces for TensorBoard
+    profile_dir: Optional[str] = None
+
     def __post_init__(self):
         if self.client_num_per_round > self.client_num_in_total:
             raise ValueError(
@@ -113,6 +119,10 @@ class FedConfig:
         if self.checkpoint_frequency < 1:
             raise ValueError(
                 f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"
+            )
+        if not 0.0 <= self.failure_prob < 1.0:
+            raise ValueError(
+                f"failure_prob must be in [0, 1), got {self.failure_prob}"
             )
         if self.ci:
             # CI fast path: shrink everything (reference fedavg_api.py:157-162).
@@ -190,6 +200,8 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)
     p.add_argument("--resume_from", type=str, default=None)
+    p.add_argument("--failure_prob", type=float, default=defaults.failure_prob)
+    p.add_argument("--profile_dir", type=str, default=None)
     p.add_argument("--config_yaml", type=str, default=None, help="optional YAML overriding flags")
     return p
 

@@ -47,6 +47,7 @@ def make_crosssilo_round(
     """
 
     def shard_fn(variables, cx, cy, cm, counts, keys):
+        variables0 = variables  # replicated original (all-failed fallback)
         # Mark the replicated global weights as device-varying before local
         # training. Without this, JAX's varying-manual-axes autodiff treats
         # the loss as a GLOBAL objective and auto-psums the gradient across
@@ -59,14 +60,20 @@ def make_crosssilo_round(
         )
         w = counts.astype(jnp.float32)
         total = jax.lax.psum(jnp.sum(w), axis)
+        denom = jnp.maximum(total, 1e-12)
 
         def reduce_leaf(x):
             wb = w.reshape((-1,) + (1,) * (x.ndim - 1))
             s = jax.lax.psum(jnp.sum(x.astype(jnp.float32) * wb, axis=0), axis)
-            return (s / total).astype(x.dtype)
+            return (s / denom).astype(x.dtype)
 
         agg = jax.tree.map(reduce_leaf, res.variables)
-        loss = jax.lax.psum(jnp.sum(res.train_loss * w), axis) / total
+        # elastic rounds: zero-count clients (failed/dropped, counts*live=0)
+        # contribute nothing; if EVERY client failed the round is a no-op —
+        # keep the old weights instead of averaging toward zero
+        keep = total > 0
+        agg = jax.tree.map(lambda n, o: jnp.where(keep, n, o), agg, variables0)
+        loss = jax.lax.psum(jnp.sum(res.train_loss * w), axis) / denom
         if server_update is not None:
             agg = server_update(variables, agg)
         return agg, loss

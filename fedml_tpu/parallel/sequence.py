@@ -114,10 +114,10 @@ def ulysses_attention(
                                   tiled=True)
 
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    part = attention_block_partial(
-        qg, kg, vg, q_offset=0, k_offset=0, causal=causal,
-        sm_scale=sm_scale, impl=impl, interpret=interpret)
-    out = normalize_partial(*part, out_dtype=q.dtype)
+    from fedml_tpu.ops.attention import attention
+
+    out = attention(qg, kg, vg, causal=causal, sm_scale=sm_scale,
+                    impl=impl, interpret=interpret)
     # inverse: sequence scatters back, head groups gather
     return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)

@@ -1,0 +1,170 @@
+"""Failure injection + elastic rounds + profiler hook.
+
+The reference's entire failure story is `raise_MPI_error -> MPI.Abort()`
+(SURVEY.md §5.3) — no detection, no recovery, no injection. Here client
+failure is a first-class simulation knob (config.failure_prob) and
+aggregation is elastic: failed clients drop out of the weighted mean with
+zero weight, and an all-failed round is a no-op instead of a NaN.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.synthetic import make_synthetic_classification
+
+
+def _ds():
+    return make_synthetic_classification(
+        "fail-tiny", (6,), 3, 6, records_per_client=12,
+        partition_method="homo", batch_size=4, seed=2,
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        model="lr", dataset="fail-tiny", client_num_in_total=6,
+        client_num_per_round=4, comm_round=4, batch_size=4, epochs=1,
+        lr=0.2, frequency_of_the_test=100, seed=21,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+class TestElasticRounds:
+    def test_failed_clients_drop_out_of_aggregate(self):
+        """A round where clients {1,3} fail must equal a round aggregated
+        over only the survivors (zero weight == absent)."""
+        ds = _ds()
+        api = FedAvgAPI(ds, _cfg())
+        sampled = np.array([0, 1, 2, 3])
+        cx, cy, cm, counts = ds.client_slice(sampled)
+        counts = np.asarray(counts, np.float32)
+        rk = jax.random.fold_in(api.root_key, 7)
+
+        live = np.array([1.0, 0.0, 1.0, 0.0], np.float32)
+        v_elastic, _, _ = api._round_step(
+            api.variables, api.server_state, cx, cy, cm,
+            jnp.asarray(counts * live), rk)
+
+        # the failed clients' data genuinely does not influence the result:
+        # corrupt their records, rerun, get the same aggregated weights
+        cx2 = np.array(cx)
+        cx2[1] += 1000.0
+        cx2[3] -= 1000.0
+        v_corrupt, _, _ = api._round_step(
+            api.variables, api.server_state, jnp.asarray(cx2), cy, cm,
+            jnp.asarray(counts * live), rk)
+        for a, b in zip(jax.tree.leaves(v_elastic), jax.tree.leaves(v_corrupt)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_all_failed_round_is_noop(self):
+        ds = _ds()
+        api = FedAvgAPI(ds, _cfg())
+        sampled = np.array([0, 1, 2, 3])
+        cx, cy, cm, counts = ds.client_slice(sampled)
+        rk = jax.random.fold_in(api.root_key, 3)
+        v, _, loss = api._round_step(
+            api.variables, api.server_state, cx, cy, cm,
+            jnp.zeros((4,), jnp.float32), rk)
+        assert np.isfinite(float(loss))
+        for a, b in zip(jax.tree.leaves(v), jax.tree.leaves(api.variables)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_failure_prob_training_stays_finite_and_learns(self):
+        ds = _ds()
+        api = FedAvgAPI(ds, _cfg(comm_round=12, failure_prob=0.4))
+        h = api.train()
+        assert all(np.isfinite(l) for l in h["Test/Loss"])
+        assert "failed_clients" in h and len(h["failed_clients"]) == 12
+        assert sum(h["failed_clients"]) > 0  # injection actually fired
+
+    def test_failure_injection_is_deterministic(self):
+        ds = _ds()
+        a = FedAvgAPI(ds, _cfg(comm_round=6, failure_prob=0.5))
+        b = FedAvgAPI(ds, _cfg(comm_round=6, failure_prob=0.5))
+        a.train()
+        b.train()
+        assert a.history["failed_clients"] == b.history["failed_clients"]
+        for x, y in zip(jax.tree.leaves(a.variables), jax.tree.leaves(b.variables)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_crosssilo_elastic_all_fail_noop(self):
+        """The in-mesh psum aggregation must also no-op on an all-failed
+        round (total weight 0) instead of averaging toward zero."""
+        from fedml_tpu.core.tasks import get_task
+        from fedml_tpu.models import create_model
+        from fedml_tpu.parallel.crosssilo import make_crosssilo_round, place_round_inputs
+        from fedml_tpu.parallel.local import make_local_train_fn
+        from fedml_tpu.parallel.mesh import client_mesh
+
+        mesh = client_mesh(8)
+        bundle = create_model("lr", 3, input_shape=(6,))
+        lt = make_local_train_fn(bundle, get_task("classification"),
+                                 optimizer="sgd", lr=0.5, epochs=1, batch_size=4)
+        round_fn = make_crosssilo_round(lt, mesh)
+        variables = bundle.init(jax.random.key(0))
+        gen = np.random.default_rng(0)
+        cx = jnp.asarray(gen.normal(size=(8, 4, 6)), jnp.float32)
+        cy = jnp.asarray(gen.integers(0, 3, (8, 4)), jnp.int32)
+        cm = jnp.ones((8, 4), jnp.float32)
+        counts = jnp.zeros((8,), jnp.float32)  # every client failed
+        keys = jax.random.split(jax.random.key(1), 8)
+        args = place_round_inputs(mesh, variables, cx, cy, cm, counts, keys)
+        new_vars, loss = round_fn(*args)
+        assert np.isfinite(float(loss))
+        for a, b in zip(jax.tree.leaves(new_vars), jax.tree.leaves(variables)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestProfileDir:
+    def test_profile_dir_writes_trace(self, tmp_path):
+        ds = _ds()
+        d = str(tmp_path / "trace")
+        api = FedAvgAPI(ds, _cfg(comm_round=2, profile_dir=d))
+        api.train()
+        # jax profiler writes plugins/profile/<ts>/*.xplane.pb under the dir
+        found = []
+        for root, _, files in os.walk(d):
+            found += [f for f in files if f.endswith((".xplane.pb", ".trace.json.gz"))]
+        assert found, f"no profiler artifacts under {d}"
+
+
+class TestServerStateRollback:
+    def test_all_failed_round_rolls_back_fedopt_moments(self):
+        """An all-failed round must not poison the server optimizer state:
+        FedOpt's moments after the no-op round equal the moments before."""
+        from fedml_tpu.algorithms.fedopt import FedOptAPI
+
+        ds = _ds()
+        api = FedOptAPI(ds, _cfg(server_optimizer="adam", server_lr=0.05))
+        api.run_round(0)  # real round so moments are non-trivial
+        before = jax.tree.map(np.asarray, api.server_state)
+        sampled = np.array([0, 1, 2, 3])
+        cx, cy, cm, _ = ds.client_slice(sampled)
+        rk = jax.random.fold_in(api.root_key, 5)
+        v, new_state, _ = api._round_step(
+            api.variables, api.server_state, cx, cy, cm,
+            jnp.zeros((4,), jnp.float32), rk)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(new_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_hierarchical_ignores_failure_prob_with_warning(self, caplog):
+        from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
+
+        ds = _ds()
+        cfg = _cfg(comm_round=2, failure_prob=0.5, group_num=2,
+                   client_num_per_round=6)
+        api = HierarchicalFedAvgAPI(ds, cfg)
+        import logging as _logging
+
+        with caplog.at_level(_logging.WARNING):
+            api.train()
+        assert "failed_clients" not in api.history  # injection disabled
+        assert any("failure_prob" in r.message for r in caplog.records)
+        for leaf in jax.tree.leaves(api.variables):
+            assert np.all(np.isfinite(np.asarray(leaf)))
