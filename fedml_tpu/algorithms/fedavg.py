@@ -272,7 +272,7 @@ class FedAvgAPI:
         return finalize_metrics(jax.tree.map(np.asarray, sums))
 
     def train(self) -> dict:
-        from fedml_tpu.utils.metrics import MetricsLogger, RoundTimer
+        from fedml_tpu.utils.metrics import MetricsLogger, RoundTimer, profile_trace
 
         c = self.config
         timer = RoundTimer()
@@ -281,8 +281,6 @@ class FedAvgAPI:
         if c.resume_from:
             start_round = self.restore(c.resume_from)
             log.info("resumed from %s at round %d", c.resume_from, start_round)
-        from fedml_tpu.utils.metrics import profile_trace
-
         with profile_trace(c.profile_dir):
             self._train_rounds(start_round, timer, logger)
         timing = timer.summary()

@@ -87,6 +87,88 @@ def make_crosssilo_round(
     return jax.jit(mapped)
 
 
+def make_hierarchical_round(
+    local_train: Callable,
+    mesh: Mesh,
+    group_rounds: int = 1,
+    group_axis: str = "group",
+    client_axis: str = "clients",
+):
+    """Two-tier aggregation on a 2-D ('group', 'clients') mesh — the
+    distributed form of hierarchical FL (SURVEY.md §2.6.5, reference
+    hierarchical_fl/trainer.py:43-69 runs it as nested Python loops over
+    processes).
+
+    Topology mapping: the ``clients`` axis should be ICI-adjacent (within a
+    pod slice) because the group aggregation psums over it every group
+    round; the ``group`` axis can ride DCN across slices because it is
+    reduced ONCE per global round. Each device holds a stack of its group's
+    clients; semantics match HierarchicalFedAvgAPI with grouping
+    gid = mesh row (see tests).
+
+    Returns round_fn(variables, cx, cy, cm, counts, keys) -> (vars, loss)
+    where cx/cy/cm/counts are stacked [G, C/G, ...] sharded over both axes
+    and keys is [group_rounds, G, C/G] per-client PRNG keys (same sharding
+    on its trailing two axes), so every client's randomness is independent.
+    """
+
+    def shard_fn(variables, cx, cy, cm, counts, keys):
+        # local shards arrive [1, c_local, ...] — flatten the group dim
+        cx, cy, cm = (a.reshape((-1,) + a.shape[2:]) for a in (cx, cy, cm))
+        counts = counts.reshape((-1,))
+        keys = keys.reshape((keys.shape[0], -1))          # [rounds, c_local]
+        variables0 = variables
+        variables = jax.tree.map(
+            lambda x: jax.lax.pcast(x, axis_name=(group_axis, client_axis),
+                                    to="varying"), variables
+        )
+        w = counts.astype(jnp.float32)
+        gmass = jax.lax.psum(jnp.sum(w), client_axis)     # this group's mass
+        gden = jnp.maximum(gmass, 1e-12)
+
+        def one_group_round(gvars, keys_local):
+            res: LocalResult = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+                gvars, cx, cy, cm, counts, keys_local
+            )
+
+            def reduce_leaf(x):
+                wb = w.reshape((-1,) + (1,) * (x.ndim - 1))
+                s = jax.lax.psum(jnp.sum(x.astype(jnp.float32) * wb, axis=0),
+                                 client_axis)            # ICI only
+                return (s / gden).astype(x.dtype)
+
+            gvars = jax.tree.map(reduce_leaf, res.variables)
+            loss = jax.lax.psum(jnp.sum(res.train_loss * w), client_axis) / gden
+            return gvars, loss
+
+        gvars, losses = jax.lax.scan(one_group_round, variables, keys)
+        # global: group models weighted by group mass — one reduce over the
+        # group axis (DCN on a real pod)
+        total = jax.lax.psum(gmass, group_axis)
+        keep = total > 0
+
+        def global_leaf(x):
+            s = jax.lax.psum(x.astype(jnp.float32) * gmass, group_axis)
+            return (s / jnp.maximum(total, 1e-12)).astype(x.dtype)
+
+        new_vars = jax.tree.map(global_leaf, gvars)
+        new_vars = jax.tree.map(lambda n, o: jnp.where(keep, n, o),
+                                new_vars, variables0)
+        loss = jax.lax.psum(losses[-1] * gmass, group_axis) / jnp.maximum(total, 1e-12)
+        return new_vars, loss
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(group_axis, client_axis), P(group_axis, client_axis),
+                  P(group_axis, client_axis), P(group_axis, client_axis),
+                  P(None, group_axis, client_axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
 def place_round_inputs(mesh: Mesh, variables, cx, cy, cm, counts, keys, axis="clients"):
     """Device placement for one round: variables replicated, client-stacked
     arrays sharded along the client axis (the round's single host->device

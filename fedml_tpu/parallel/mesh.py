@@ -32,6 +32,32 @@ def hierarchical_mesh(num_groups: int, clients_per_group: int) -> Mesh:
     return Mesh(arr, ("group", "clients"))
 
 
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> int:
+    """Join a multi-host TPU pod (or GPU/CPU cluster) run.
+
+    Counterpart of the reference's mpirun + hostfile + rank→IP csv bootstrap
+    (run_fedavg_distributed_pytorch.sh:19-23, ip_config_utils): one call to
+    ``jax.distributed.initialize`` (env-driven on TPU pods — all args
+    optional there) after which ``jax.devices()`` spans every host and the
+    same Mesh/psum code runs unchanged with DCN collectives between hosts.
+    Returns this process's index. Idempotent: repeated calls are no-ops.
+    """
+    if getattr(init_multihost, "_done", False) or jax.distributed.is_initialized():
+        return jax.process_index()
+    kw = {}
+    if coordinator_address is not None:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(**kw)
+    init_multihost._done = True
+    return jax.process_index()
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

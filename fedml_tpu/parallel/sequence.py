@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedml_tpu.ops.attention import (
     NEG_INF,
+    attention,
     attention_block_partial,
     merge_partials,
     normalize_partial,
@@ -114,8 +115,6 @@ def ulysses_attention(
                                   tiled=True)
 
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    from fedml_tpu.ops.attention import attention
-
     out = attention(qg, kg, vg, causal=causal, sm_scale=sm_scale,
                     impl=impl, interpret=interpret)
     # inverse: sequence scatters back, head groups gather
