@@ -56,7 +56,18 @@ def _load(config: FedConfig):
 
 def run_experiment(config: FedConfig, algorithm: str) -> dict:
     """Build data + model + API for `algorithm`, run it, return its final
-    history/metrics dict (also JSON-logged, wandb-style keys)."""
+    history/metrics dict (also JSON-logged, wandb-style keys). On
+    successful completion, signals any sweep orchestrator listening on
+    FEDML_SWEEP_PIPE (reference fedavg/utils.py:19-26 posts the same from
+    the server manager at end of run) — exactly once per experiment."""
+    result = _run_experiment(config, algorithm)
+    from fedml_tpu.utils.metrics import notify_sweep_complete
+
+    notify_sweep_complete()
+    return result
+
+
+def _run_experiment(config: FedConfig, algorithm: str) -> dict:
     algorithm = algorithm.lower()
     if algorithm not in ALGORITHMS:
         raise KeyError(f"unknown algorithm {algorithm!r}; known: {ALGORITHMS}")

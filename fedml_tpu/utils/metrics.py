@@ -110,6 +110,37 @@ class MetricsLogger:
             self._wandb.finish()
 
 
+def notify_sweep_complete(pipe_path: Optional[str] = None) -> bool:
+    """Signal an external sweep orchestrator that this run finished.
+
+    Counterpart of the reference's ``post_complete_message_to_sweep_process``
+    (fedavg/utils.py:19-26: open a FIFO ``./tmp/fedml`` and write
+    'training is finished!'). Path comes from the FEDML_SWEEP_PIPE env var
+    (or the argument); no-op when unset or the FIFO has no reader — a
+    missing orchestrator must never block or fail training. Returns
+    whether the message was written."""
+    import errno
+    import os
+
+    path = pipe_path or os.environ.get("FEDML_SWEEP_PIPE")
+    if not path:
+        return False
+    try:
+        # O_NONBLOCK: never hang when no sweep process is reading
+        fd = os.open(path, os.O_WRONLY | os.O_NONBLOCK)
+    except OSError as e:
+        if e.errno != errno.ENXIO:  # ENXIO = FIFO exists but no reader
+            log.debug("sweep pipe %s unavailable: %s", path, e)
+        return False
+    try:
+        os.write(fd, b"training is finished!\n")
+        return True
+    except OSError:
+        return False
+    finally:
+        os.close(fd)
+
+
 @contextlib.contextmanager
 def profile_trace(logdir: Optional[str]):
     """Wrap a region in a jax profiler trace (TensorBoard format). No-op

@@ -52,3 +52,45 @@ def test_fedavg_exposes_timing():
     assert hist["rounds_per_sec"] > 0
     assert "time/train_s" in hist["timing"]
     assert "Test/Acc" in hist and len(hist["Test/Acc"]) == 2
+
+
+class TestSweepPipe:
+    """Counterpart of post_complete_message_to_sweep_process
+    (fedavg/utils.py:19-26): completion signal to an external sweep
+    orchestrator, never blocking when none is listening."""
+
+    def test_writes_to_fifo_with_reader(self, tmp_path):
+        import os
+        import threading
+
+        from fedml_tpu.utils.metrics import notify_sweep_complete
+
+        fifo = str(tmp_path / "sweep")
+        os.mkfifo(fifo)
+        got = []
+
+        def reader():
+            with open(fifo, "rb") as f:
+                got.append(f.readline())
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        import time as _t
+
+        for _ in range(50):  # wait for the reader to open
+            if notify_sweep_complete(fifo):
+                break
+            _t.sleep(0.05)
+        t.join(timeout=5)
+        assert got and b"finished" in got[0]
+
+    def test_noop_without_reader_or_pipe(self, tmp_path):
+        import os
+
+        from fedml_tpu.utils.metrics import notify_sweep_complete
+
+        assert notify_sweep_complete(None) is False          # unset
+        fifo = str(tmp_path / "sweep2")
+        os.mkfifo(fifo)
+        assert notify_sweep_complete(fifo) is False          # no reader
+        assert notify_sweep_complete(str(tmp_path / "nope")) is False
