@@ -76,7 +76,8 @@ class FedAvgAPI:
         None when disabled/too large."""
         c = self.config
         if not self.supports_device_data or c.device_data == "off":
-            if c.device_data == "on" and not self.supports_device_data:
+            if (c.device_data == "on" and not self.supports_device_data
+                    and not getattr(self, "handles_own_device_data", False)):
                 log.warning(
                     "device_data='on' ignored: %s shards round inputs itself; "
                     "using the host-slice path", type(self).__name__,
@@ -92,25 +93,39 @@ class FedAvgAPI:
                     "path", type(self).__name__,
                 )
             return None
-        if c.device_data == "auto" and jax.default_backend() == "cpu":
-            # no host->device hop to avoid on CPU; a second in-RAM copy of the
-            # dataset would be pure cost ('on' still forces it, e.g. for tests)
+        x = self._eligible_device_train_x()
+        if x is None:
             return None
         ds = self.dataset
-        x = ds.train_x
-        cast_bf16 = c.dtype == "bfloat16" and np.issubdtype(x.dtype, np.floating)
-        nbytes = ((x.size * 2 if cast_bf16 else x.nbytes) + ds.train_y.nbytes
-                  + ds.train_mask.nbytes + ds.train_counts.nbytes)
-        if c.device_data == "auto" and nbytes > c.device_data_max_bytes:
-            return None
-        if cast_bf16:
-            x = jnp.asarray(x, jnp.bfloat16)  # halves HBM + transfer cost
         return (
             jax.device_put(x),
             jax.device_put(ds.train_y),
             jax.device_put(ds.train_mask),
             jax.device_put(jnp.asarray(ds.train_counts, jnp.float32)),
         )
+
+    def _eligible_device_train_x(self, shard_factor: int = 1):
+        """Shared device-residency eligibility + bf16 pre-cast for train_x.
+
+        ``shard_factor`` = number of devices the stacked arrays will be
+        sharded across (1 = fully replicated/single-device): the 'auto'
+        byte budget applies to the PER-DEVICE footprint. Auto also declines
+        CPU backends — there is no host->device hop to avoid, and a second
+        in-RAM copy of the dataset would be pure cost ('on' still forces
+        it, e.g. for tests). Returns train_x (bf16-cast when training in
+        bf16) or None when ineligible."""
+        c = self.config
+        ds = self.dataset
+        x = ds.train_x
+        cast_bf16 = c.dtype == "bfloat16" and np.issubdtype(x.dtype, np.floating)
+        nbytes = ((x.size * 2 if cast_bf16 else x.nbytes) + ds.train_y.nbytes
+                  + ds.train_mask.nbytes + ds.train_counts.nbytes)
+        if c.device_data == "auto" and (
+            jax.default_backend() == "cpu"
+            or nbytes / max(shard_factor, 1) > c.device_data_max_bytes
+        ):
+            return None
+        return jnp.asarray(x, jnp.bfloat16) if cast_bf16 else x
 
     # -- factory methods subclasses override ---------------------------------
 
@@ -323,7 +338,8 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
     trains cohort/mesh_size clients per round under vmap.
     """
 
-    supports_device_data = False  # round inputs are sharded by place_round_inputs
+    supports_device_data = False  # base gather path replaced by _dev_sharded
+    handles_own_device_data = True  # _maybe_place_sharded honors the flag
     elastic_rounds_ok = True      # the psum path guards zero total weight
 
     def __init__(self, dataset, config, bundle=None, mesh=None):
@@ -343,6 +359,52 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                 f"effective cohort size ({cohort}) must be a multiple of the "
                 f"mesh 'clients' axis ({n_clients_axis})"
             )
+        self._dev_sharded = self._maybe_place_sharded(cohort)
+
+    def _maybe_place_sharded(self, cohort: int):
+        """Full-participation cross-silo (the standard silo deployment:
+        every silo trains every round) keeps the whole dataset RESIDENT and
+        SHARDED over the mesh — each device holds its clients' records in
+        its own HBM, so rounds have zero host->device data movement (the
+        in-mesh analogue of the simulation paradigm's device_data gather).
+        Partial participation keeps the per-round host slice (a gather
+        across shards would move data anyway)."""
+        c = self.config
+        ds = self.dataset
+        if c.device_data == "off":
+            return None
+        if cohort != ds.num_clients:
+            if c.device_data == "on":
+                log.warning(
+                    "device_data='on' ignored for cross-silo partial "
+                    "participation (%d/%d clients); resident sharding needs "
+                    "full participation", cohort, ds.num_clients)
+            return None
+        n_shards = dict(zip(self.mesh.axis_names,
+                            self.mesh.devices.shape)).get("clients", 1)
+        x = self._eligible_device_train_x(shard_factor=n_shards)
+        if x is None:
+            return None
+        from fedml_tpu.parallel.mesh import shard_client_batch
+
+        return shard_client_batch(
+            self.mesh,
+            (x, ds.train_y, ds.train_mask,
+             np.asarray(ds.train_counts, np.float32)),
+        )
+
+    def run_round(self, round_idx: int) -> float:
+        if self._dev_sharded is None:
+            return super().run_round(round_idx)
+        cx, cy, cm, counts = self._dev_sharded
+        live = self._sample_failures(round_idx, self.dataset.num_clients)
+        if live is not None:
+            counts = counts * jnp.asarray(live, jnp.float32)
+        rk = round_key(self.root_key, round_idx)
+        self.variables, self.server_state, train_loss = self._round_step(
+            self.variables, self.server_state, cx, cy, cm, counts, rk
+        )
+        return float(train_loss)
 
     def build_round_step(self):
         from fedml_tpu.parallel.crosssilo import make_crosssilo_round, place_round_inputs

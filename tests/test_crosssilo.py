@@ -133,3 +133,62 @@ class TestHierarchicalMesh:
         for a, b in zip(jax.tree.leaves(sim_vars), jax.tree.leaves(mesh_vars)):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+class TestCrossSiloResidentData:
+    """Full-participation cross-silo with device_data='on' keeps the
+    dataset sharded-resident; rounds must be bit-identical to the
+    per-round host-slice path."""
+
+    def test_resident_sharded_matches_host_path(self):
+        import jax
+        import numpy as np
+
+        from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI
+        from fedml_tpu.core.config import FedConfig
+        from fedml_tpu.data.synthetic import make_synthetic_classification
+        from fedml_tpu.parallel.mesh import client_mesh
+
+        C = 8
+        ds = make_synthetic_classification(
+            "silo-res", (6,), 3, C, records_per_client=8,
+            partition_method="homo", batch_size=4, seed=3,
+        )
+        kw = dict(
+            model="lr", dataset="silo-res", client_num_in_total=C,
+            client_num_per_round=C, comm_round=3, batch_size=4, epochs=1,
+            lr=0.3, seed=23, frequency_of_the_test=100,
+        )
+        mesh = client_mesh(8)
+        on = CrossSiloFedAvgAPI(ds, FedConfig(device_data="on", **kw), mesh=mesh)
+        off = CrossSiloFedAvgAPI(ds, FedConfig(device_data="off", **kw), mesh=mesh)
+        assert on._dev_sharded is not None
+        assert off._dev_sharded is None
+        for r in range(3):
+            l_on = on.run_round(r)
+            l_off = off.run_round(r)
+            assert np.isclose(l_on, l_off, rtol=1e-6), (r, l_on, l_off)
+        for a, b in zip(jax.tree.leaves(on.variables), jax.tree.leaves(off.variables)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+    def test_partial_participation_declines_with_warning(self, caplog):
+        import logging as _logging
+
+        from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI
+        from fedml_tpu.core.config import FedConfig
+        from fedml_tpu.data.synthetic import make_synthetic_classification
+        from fedml_tpu.parallel.mesh import client_mesh
+
+        ds = make_synthetic_classification(
+            "silo-part", (6,), 3, 16, records_per_client=8,
+            partition_method="homo", batch_size=4, seed=3,
+        )
+        cfg = FedConfig(
+            model="lr", dataset="silo-part", client_num_in_total=16,
+            client_num_per_round=8, comm_round=1, batch_size=4,
+            lr=0.3, seed=2, device_data="on",
+        )
+        with caplog.at_level(_logging.WARNING):
+            api = CrossSiloFedAvgAPI(ds, cfg, mesh=client_mesh(8))
+        assert api._dev_sharded is None
+        assert any("partial" in r.message for r in caplog.records)
