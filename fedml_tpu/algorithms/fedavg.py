@@ -125,7 +125,15 @@ class FedAvgAPI:
             or nbytes / max(shard_factor, 1) > c.device_data_max_bytes
         ):
             return None
-        return jnp.asarray(x, jnp.bfloat16) if cast_bf16 else x
+        if cast_bf16:
+            # cast on HOST (numpy + ml_dtypes) so the array stays host-side:
+            # the caller's device_put then ships each shard straight to its
+            # device — a jnp cast here would materialize the whole array on
+            # one device first and OOM exactly the sharded-placement case
+            import ml_dtypes
+
+            return x.astype(ml_dtypes.bfloat16)
+        return x
 
     # -- factory methods subclasses override ---------------------------------
 
@@ -380,9 +388,7 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                     "participation (%d/%d clients); resident sharding needs "
                     "full participation", cohort, ds.num_clients)
             return None
-        n_shards = dict(zip(self.mesh.axis_names,
-                            self.mesh.devices.shape)).get("clients", 1)
-        x = self._eligible_device_train_x(shard_factor=n_shards)
+        x = self._eligible_device_train_x(shard_factor=self.mesh.shape["clients"])
         if x is None:
             return None
         from fedml_tpu.parallel.mesh import shard_client_batch
