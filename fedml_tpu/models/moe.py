@@ -1,0 +1,119 @@
+"""Mixture-of-experts transformer blocks with expert parallelism.
+
+The reference has no MoE (and no LLM-era parallelism at all, SURVEY.md
+§2.6); this exists so the framework's parallelism surface covers the EP
+axis alongside dp/tp/sp/clients/group.
+
+Design: the MoE MLP keeps expert weights stacked on a leading expert axis
+``[E, ...]`` — sharding that axis over an 'ep' mesh axis IS expert
+parallelism (each device stores and computes only its experts). Routing is
+a dense softmax-weighted top-k dispatch expressed as einsums over the
+expert axis, which makes the layer exactly equal to its single-device
+form under GSPMD (no capacity dropping, no load-balancing noise) — the
+right correctness baseline for a framework; a capacity-limited all_to_all
+dispatch is a performance specialization of the same parameter layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.transformer import SelfAttention
+
+
+def top_k_probs(router_logits: jax.Array, top_k: int) -> jax.Array:
+    """Softmax the router logits, keep each token's top-k experts, and
+    renormalize so the kept weights sum to 1 (fully differentiable)."""
+    E = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    if top_k < E:
+        kth = jnp.sort(probs, axis=-1)[..., E - top_k][..., None]
+        probs = jnp.where(probs >= kth, probs, 0.0)
+        probs = probs / jnp.maximum(jnp.sum(probs, axis=-1, keepdims=True), 1e-9)
+    return probs
+
+
+class MoeMlp(nn.Module):
+    """Softmax-routed top-k mixture of expert MLPs (dense dispatch)."""
+
+    dim: int
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    top_k: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h):
+        E, D, F = self.num_experts, self.dim, self.mlp_ratio * self.dim
+        router = nn.Dense(E, dtype=jnp.float32, name="router")(
+            h.astype(jnp.float32))                      # [B, T, E]
+        probs = top_k_probs(router, self.top_k)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (E, D, F), jnp.float32).astype(self.dtype)
+        b_up = self.param("b_up", nn.initializers.zeros, (E, F), jnp.float32)
+        w_dn = self.param("w_dn", nn.initializers.lecun_normal(),
+                          (E, F, D), jnp.float32).astype(self.dtype)
+        b_dn = self.param("b_dn", nn.initializers.zeros, (E, D), jnp.float32)
+        h = h.astype(self.dtype)
+        # every expert computes every token; the router weights combine.
+        # einsum over the (sharded) expert axis -> per-device partial sums,
+        # one psum inserted by GSPMD at the combine.
+        up = jnp.einsum("btd,edf->ebtf", h, w_up) + b_up[:, None, None, :].astype(self.dtype)
+        act = nn.gelu(up)
+        down = jnp.einsum("ebtf,efd->ebtd", act, w_dn) + b_dn[:, None, None, :].astype(self.dtype)
+        out = jnp.einsum("bte,ebtd->btd", probs.astype(self.dtype), down)
+        return out
+
+
+class MoeBlock(nn.Module):
+    dim: int
+    heads: int
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    top_k: int = 2
+    attn_impl: str = "auto"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, train: bool = False):
+        a = SelfAttention(self.dim, self.heads, self.attn_impl,
+                          dtype=self.dtype, name="attn")(
+            nn.LayerNorm(dtype=self.dtype)(h))
+        h = h + a
+        m = MoeMlp(self.dim, self.num_experts, self.mlp_ratio, self.top_k,
+                   self.dtype, name="moe")(nn.LayerNorm(dtype=self.dtype)(h))
+        return h + m
+
+
+class MoeTransformerLM(nn.Module):
+    """Decoder-only LM with MoE MLPs — the EP counterpart of TransformerLM."""
+
+    vocab_size: int
+    dim: int = 256
+    heads: int = 8
+    layers: int = 4
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    top_k: int = 2
+    max_len: int = 4096
+    attn_impl: str = "auto"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, pos_offset=0):
+        t = x.shape[1]
+        h = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                     name="tok_embed")(x.astype(jnp.int32))
+        pos = pos_offset + jnp.arange(t)
+        h = h + nn.Embed(self.max_len, self.dim, dtype=self.dtype,
+                         name="pos_embed")(pos)[None]
+        for i in range(self.layers):
+            h = MoeBlock(self.dim, self.heads, self.num_experts,
+                         self.mlp_ratio, self.top_k, self.attn_impl,
+                         self.dtype, name=f"block{i}")(h, train)
+        h = nn.LayerNorm(dtype=self.dtype)(h)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(h)

@@ -23,7 +23,7 @@ to float tolerance (tested).
 from __future__ import annotations
 
 import re
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +31,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-#: (path regex, spec builder) — first match wins; default replicated.
+#: Megatron TP placement: (path regex, spec builder) — first match wins;
+#: default replicated.
 _TP_RULES = (
     (re.compile(r"attn.*qkv.*kernel"), lambda tp: P(None, tp)),
     (re.compile(r"attn.*qkv.*bias"), lambda tp: P(tp)),
@@ -41,34 +42,71 @@ _TP_RULES = (
     (re.compile(r"Dense_1.*kernel"), lambda tp: P(tp, None)),   # MLP down
 )
 
+#: expert-parallel placement: stacked expert weights [E, ...] shard their
+#: leading (expert) axis; router + everything else replicated.
+_EP_RULES = (
+    (re.compile(r"moe.*w_(up|dn)"), lambda ep: P(ep)),
+    (re.compile(r"moe.*b_(up|dn)"), lambda ep: P(ep)),
+)
 
-def tp_spec(path: str, tp_axis: str = "tp") -> P:
-    """Megatron PartitionSpec for one parameter path (default replicated)."""
-    for rx, spec in _TP_RULES:
+
+def _spec_for(rules, path: str, axis: str) -> P:
+    for rx, spec in rules:
         if rx.search(path):
-            return spec(tp_axis)
+            return spec(axis)
     return P()
 
 
-def shard_params_tp(variables, mesh: Mesh, tp_axis: str = "tp"):
-    """device_put the variable tree with Megatron TP shardings over
-    ``mesh``'s 'tp' axis. Heads and MLP hidden must divide the axis size."""
-
+def _shard_params(variables, mesh: Mesh, rules, axis: str):
     def place(path, leaf):
-        spec = tp_spec(jax.tree_util.keystr(path), tp_axis)
+        spec = _spec_for(rules, jax.tree_util.keystr(path), axis)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, variables)
 
 
+def _mesh2d(n_dp: int, n_other: int, other_axis: str) -> Mesh:
+    devs = jax.devices()
+    need = n_dp * n_other
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:need]).reshape(n_dp, n_other),
+                ("dp", other_axis))
+
+
+def tp_spec(path: str, tp_axis: str = "tp") -> P:
+    """Megatron PartitionSpec for one parameter path (default replicated)."""
+    return _spec_for(_TP_RULES, path, tp_axis)
+
+
+def shard_params_tp(variables, mesh: Mesh, tp_axis: str = "tp"):
+    """device_put the variable tree with Megatron TP shardings over
+    ``mesh``'s 'tp' axis. Heads and MLP hidden must divide the axis size."""
+    return _shard_params(variables, mesh, _TP_RULES, tp_axis)
+
+
 def tp_mesh(n_dp: int, n_tp: int) -> Mesh:
     """2-D (dp, tp) mesh: batch over dp, tensor-parallel over tp (keep tp
     ICI-adjacent — it all-reduces twice per layer)."""
-    devs = jax.devices()
-    need = n_dp * n_tp
-    if len(devs) < need:
-        raise ValueError(f"need {need} devices, have {len(devs)}")
-    return Mesh(np.asarray(devs[:need]).reshape(n_dp, n_tp), ("dp", "tp"))
+    return _mesh2d(n_dp, n_tp, "tp")
+
+
+def ep_spec(path: str, ep_axis: str = "ep") -> P:
+    """Expert-parallel PartitionSpec for one parameter path."""
+    return _spec_for(_EP_RULES, path, ep_axis)
+
+
+def shard_params_ep(variables, mesh: Mesh, ep_axis: str = "ep"):
+    """device_put a MoeTransformerLM variable tree with the expert axis of
+    every expert weight sharded over ``mesh``'s 'ep' axis — each device
+    stores (and computes) only its experts. num_experts must divide the
+    axis size."""
+    return _shard_params(variables, mesh, _EP_RULES, ep_axis)
+
+
+def ep_mesh(n_dp: int, n_ep: int) -> Mesh:
+    """2-D (dp, ep) mesh: batch over dp, experts over ep."""
+    return _mesh2d(n_dp, n_ep, "ep")
 
 
 def make_tp_lm_train_step(
