@@ -63,6 +63,7 @@ class FedAvgAPI:
         self.server_state = self.init_server_state()
         self._round_step = self.build_round_step()
         self._dev_train = self._maybe_place_train_data()
+        self._gather_steps: dict[int, Callable] = {}
         if self._dev_train is not None:
             self._round_step_gather = self.build_round_step_gather()
         self.history: dict[str, list] = {"round": [], "Test/Acc": [], "Test/Loss": []}
@@ -183,10 +184,15 @@ class FedAvgAPI:
 
         return round_step
 
-    def build_round_step_gather(self):
+    def build_round_step_gather(self, bucket: Optional[int] = None):
         """Round step over device-resident data: the sampled cohort enters as
         an index vector; the gather happens in HBM inside the same program.
-        ``live`` [cohort] zeroes failed clients' weights (elastic rounds)."""
+        ``live`` [cohort] zeroes failed clients' weights (elastic rounds).
+        ``bucket`` (static) truncates the per-client record axis to the
+        cohort's real maximum — loaders put real records first, so the tail
+        holds no real data and the trimmed steps were masked no-ops (the
+        epoch shuffle stream does change with the axis length; see
+        FedConfig.bucket_quantum_batches)."""
         body = self._round_body
 
         @jax.jit
@@ -194,10 +200,28 @@ class FedAvgAPI:
             cx = jnp.take(tx, idx, axis=0)
             cy = jnp.take(ty, idx, axis=0)
             cm = jnp.take(tm, idx, axis=0)
+            if bucket is not None:
+                cx, cy, cm = cx[:, :bucket], cy[:, :bucket], cm[:, :bucket]
             counts = jnp.take(tcounts, idx, axis=0) * live
             return body(variables, server_state, cx, cy, cm, counts, rng)
 
         return round_step
+
+    def _round_bucket(self, sampled: np.ndarray, live: Optional[np.ndarray]) -> Optional[int]:
+        """Static scan length for this round: max real count over the live
+        cohort, rounded up to bucket_quantum_batches*batch_size. None = use
+        the global n_pad (bucketing off, or nothing to trim)."""
+        c = self.config
+        n_pad = int(self.dataset.train_x.shape[1])
+        q = c.bucket_quantum_batches * c.batch_size
+        if c.bucket_quantum_batches <= 0 or q >= n_pad:
+            return None
+        counts = np.asarray(self.dataset.train_counts, np.float64)[sampled]
+        if live is not None:
+            counts = counts * live
+        maxc = float(counts.max()) if counts.size else 0.0
+        bucket = int(np.ceil(max(maxc, 1.0) / q) * q)
+        return None if bucket >= n_pad else bucket
 
     def _sample_failures(self, round_idx: int, cohort: int) -> Optional[np.ndarray]:
         """Deterministic per-round fault injection (SURVEY.md §5.3: the
@@ -239,15 +263,24 @@ class FedAvgAPI:
                                  seed=c.seed)
         rk = round_key(self.root_key, round_idx)
         live = self._sample_failures(round_idx, len(sampled))
+        bucket = self._round_bucket(sampled, live)
         if self._dev_train is not None:
             live_v = (jnp.ones((len(sampled),), jnp.float32) if live is None
                       else jnp.asarray(live))
-            self.variables, self.server_state, train_loss = self._round_step_gather(
+            if bucket is None:
+                step = self._round_step_gather
+            else:
+                step = self._gather_steps.get(bucket)
+                if step is None:
+                    step = self._gather_steps[bucket] = self.build_round_step_gather(bucket)
+            self.variables, self.server_state, train_loss = step(
                 self.variables, self.server_state, *self._dev_train,
                 jnp.asarray(sampled, jnp.int32), live_v, rk
             )
         else:
             cx, cy, cm, counts = self.dataset.client_slice(sampled)
+            if bucket is not None:
+                cx, cy, cm = cx[:, :bucket], cy[:, :bucket], cm[:, :bucket]
             counts = np.asarray(counts, np.float32)
             if live is not None:
                 counts = counts * live

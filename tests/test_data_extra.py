@@ -74,3 +74,103 @@ def test_synthesized_edge_cases_exclude_target_class():
     )
     _, y_true = _synthesize_edge_cases(base, 64, 3, np.random.default_rng(0))
     assert not np.any(y_true == 3)
+
+
+def test_stackoverflow_real_h5_paths(tmp_path):
+    """Real TFF-h5 parsing for stackoverflow_lr/nwp: tiny fake corpus with the
+    reference layout (examples/<cid>/tokens|title|tags + word/tag count
+    tables, stackoverflow_lr/dataset.py:21-60, utils.py:32-62)."""
+    import json
+
+    import h5py
+
+    d = str(tmp_path)
+    words = ["the", "cat", "sat", "on", "mat", "dog", "ran", "far"]
+    with open(f"{d}/stackoverflow.word_count", "w") as f:
+        for i, w in enumerate(words):
+            f.write(f"{w} {100 - i}\n")
+    with open(f"{d}/stackoverflow.tag_count", "w") as f:
+        json.dump({"python": 50, "jax": 40, "tpu": 30}, f)
+    for fname in ("stackoverflow_train.h5", "stackoverflow_test.h5"):
+        with h5py.File(f"{d}/{fname}", "w") as f:
+            for cid in ("alice", "bob"):
+                g = f.create_group(f"examples/{cid}")
+                g.create_dataset("tokens", data=[b"the cat sat", b"dog ran far zzz"])
+                g.create_dataset("title", data=[b"on mat", b"the dog"])
+                g.create_dataset("tags", data=[b"python|jax", b"tpu|unknown"])
+
+    from fedml_tpu.data.stackoverflow import load_stackoverflow_lr, load_stackoverflow_nwp
+
+    lr = load_stackoverflow_lr(data_dir=d, client_num_in_total=2, batch_size=2)
+    assert lr.train_x.shape[0] == 2 and lr.train_x.shape[-1] == len(words)
+    assert lr.class_num == 3
+    # "the cat sat on mat": all 5 tokens in-vocab -> bag sums to 1
+    np.testing.assert_allclose(lr.train_x[0, 0].sum(), 1.0, atol=1e-6)
+    # tags "python|jax" -> exactly two hot
+    assert lr.train_y[0, 0].sum() == 2.0
+    # OOV token ("zzz") drops out: mean bag sums to 5/6
+    np.testing.assert_allclose(lr.train_x[0, 1].sum(), 5.0 / 6.0, atol=1e-6)
+
+    nwp = load_stackoverflow_nwp(data_dir=d, client_num_in_total=2, batch_size=2)
+    V = len(words)
+    bos, eos, oov = V + 1, V + 2, V + 3
+    assert nwp.class_num == V + 4
+    x0, y0 = nwp.train_x[0, 0], nwp.train_y[0, 0]
+    assert x0[0] == bos                      # every sequence starts with bos
+    assert y0[0] == 1                        # "the" is word id 1 (pad=0)
+    assert eos in np.concatenate([x0, y0])   # short sentence gets eos
+    assert x0.shape[0] == 20 and y0.shape[0] == 20
+    # second sentence has the OOV bucket for "zzz"
+    assert oov in np.concatenate([nwp.train_x[0, 1], nwp.train_y[0, 1]])
+
+
+def test_tff_h5_real_paths(tmp_path):
+    """Real-h5 parsing for femnist / fed_cifar100 / fed_shakespeare with tiny
+    fabricated TFF-layout files (examples/<cid>/pixels|image|label|snippets,
+    reference FederatedEMNIST/data_loader.py:26-151)."""
+    import h5py
+
+    rng = np.random.default_rng(0)
+
+    femd = tmp_path / "femnist"; femd.mkdir()
+    for fname in ("fed_emnist_train.h5", "fed_emnist_test.h5"):
+        with h5py.File(femd / fname, "w") as f:
+            for cid in ("c0", "c1", "c2"):
+                g = f.create_group(f"examples/{cid}")
+                g.create_dataset("pixels", data=rng.random((5, 28, 28), np.float32))
+                g.create_dataset("label", data=rng.integers(0, 62, 5))
+    from fedml_tpu.data.femnist import load_fed_cifar100, load_femnist
+
+    fem = load_femnist(data_dir=str(femd), client_num_in_total=2, batch_size=2)
+    assert fem.name == "femnist" and fem.train_x.shape[0] == 2
+    assert fem.train_x.shape[2:] == (28, 28, 1) and fem.class_num == 62
+    assert fem.train_counts.tolist() == [5, 5]
+
+    fcd = tmp_path / "fc100"; fcd.mkdir()
+    for fname in ("fed_cifar100_train.h5", "fed_cifar100_test.h5"):
+        with h5py.File(fcd / fname, "w") as f:
+            for cid in ("c0", "c1"):
+                g = f.create_group(f"examples/{cid}")
+                g.create_dataset("image", data=rng.integers(0, 255, (4, 32, 32, 3), np.uint8))
+                g.create_dataset("label", data=rng.integers(0, 100, 4))
+    fc = load_fed_cifar100(data_dir=str(fcd), client_num_in_total=2, batch_size=2)
+    assert fc.name == "fed_cifar100"
+    assert fc.train_x.shape[2:] == (24, 24, 3)      # center crop 32->24
+    assert abs(float(fc.train_x.mean())) < 3.0      # normalized, not raw 0..255
+
+    shd = tmp_path / "shk"; shd.mkdir()
+    for fname in ("shakespeare_train.h5", "shakespeare_test.h5"):
+        with h5py.File(shd / fname, "w") as f:
+            for cid in ("king", "fool"):
+                g = f.create_group(f"examples/{cid}")
+                g.create_dataset(
+                    "snippets",
+                    data=[b"To be, or not to be, that is the question: " * 12],
+                )
+    from fedml_tpu.data.shakespeare import load_fed_shakespeare
+
+    sh = load_fed_shakespeare(data_dir=str(shd), client_num_in_total=2, batch_size=2)
+    assert sh.name == "fed_shakespeare" and sh.class_num == 90
+    assert sh.train_x.shape[0] == 2 and sh.train_x.dtype == np.int32
+    # next-word shift: y[t] == x[t+1] inside real records
+    assert (sh.train_x[0, 0, 1:] == sh.train_y[0, 0, :-1]).all()

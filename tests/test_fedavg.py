@@ -149,3 +149,63 @@ class TestDeviceResidentData:
             ds, FedConfig(device_data="on", device_data_max_bytes=1, **kw)
         )
         assert capped._dev_train is not None  # budget only gates 'auto'
+
+
+class TestCohortBucketing:
+    """bucket_quantum_batches: per-round scan truncation to the live cohort's
+    max real count (dead padded SGD steps are pure waste under hetero/LDA
+    partitions where global n_pad is set by the single biggest client)."""
+
+    def _ragged_ds(self):
+        # client sizes 6,6,6,30 with bs 2 -> n_pad 30; quantum 1 batch = 2
+        rng = np.random.default_rng(3)
+        w_true = rng.normal(0, 1, (6, 3))
+        xs = [rng.normal(0, 1, (n, 6)).astype(np.float32) for n in (6, 6, 6, 30)]
+        ys = [np.argmax(x @ w_true, axis=1).astype(np.int32) for x in xs]
+        from fedml_tpu.data import FedDataset
+        from fedml_tpu.data.batching import pad_and_stack_clients, pad_eval_pool
+
+        tx, ty, tm, tc = pad_and_stack_clients(xs, ys, 2)
+        ex, ey, em = pad_eval_pool(np.concatenate(xs), np.concatenate(ys), 8)
+        return FedDataset(train_x=tx, train_y=ty, train_mask=tm, train_counts=tc,
+                          test_x=ex, test_y=ey, test_mask=em, class_num=3,
+                          name="ragged")
+
+    def _cfg(self, **kw):
+        kw.setdefault("comm_round", 4)
+        return FedConfig(model="lr", client_num_in_total=4, client_num_per_round=3,
+                         batch_size=2, lr=0.3, frequency_of_the_test=100, **kw)
+
+    def test_round_bucket_math(self):
+        ds = self._ragged_ds()
+        api = FedAvgAPI(ds, self._cfg(bucket_quantum_batches=1),
+                        create_model("lr", 3, input_shape=(6,)))
+        # cohort of small clients: bucket = ceil(6/2)*2 = 6
+        assert api._round_bucket(np.array([0, 1, 2]), None) == 6
+        # the big client drags the bucket to n_pad -> None (nothing to trim)
+        assert api._round_bucket(np.array([0, 3]), None) is None
+        # failure-masked big client doesn't inflate the bucket
+        assert api._round_bucket(np.array([0, 3]), np.array([1.0, 0.0])) == 6
+        # quantum 0 disables
+        api0 = FedAvgAPI(ds, self._cfg(bucket_quantum_batches=0),
+                         create_model("lr", 3, input_shape=(6,)))
+        assert api0._round_bucket(np.array([0, 1]), None) is None
+
+    def test_bucketed_training_converges_host_path(self):
+        ds = self._ragged_ds()
+        api = FedAvgAPI(ds, self._cfg(bucket_quantum_batches=1, comm_round=25),
+                        create_model("lr", 3, input_shape=(6,)))
+        hist = api.train()
+        assert hist["Test/Acc"][-1] > 0.5
+
+    def test_bucketed_gather_path_matches_quality(self):
+        # device_data='on' forces the resident-gather path even on CPU
+        ds = self._ragged_ds()
+        api = FedAvgAPI(ds, self._cfg(bucket_quantum_batches=1, comm_round=25,
+                                      device_data="on"),
+                        create_model("lr", 3, input_shape=(6,)))
+        assert api._dev_train is not None
+        hist = api.train()
+        assert api._gather_steps, "bucketed rounds should compile bucket programs"
+        assert all(b % 2 == 0 and b < ds.train_x.shape[1] for b in api._gather_steps)
+        assert hist["Test/Acc"][-1] > 0.5
