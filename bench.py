@@ -43,7 +43,6 @@ def main():
     import jax.numpy as jnp
 
     from fedml_tpu.core.config import FedConfig
-    from fedml_tpu.core.rng import sample_clients
     from fedml_tpu.data.synthetic import make_synthetic_classification
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
     from fedml_tpu.models import create_model
@@ -68,7 +67,8 @@ def main():
         batch_size=batch, epochs=EPOCHS, lr=0.1, momentum=0.9,
         dtype="bfloat16", frequency_of_the_test=10_000, seed=0,
     )
-    bundle = create_model(model, 10, dtype=jnp.bfloat16)
+    bundle = create_model(model, 10, dtype=jnp.bfloat16,
+                          input_shape=ds.train_x.shape[2:])
     api = FedAvgAPI(ds, cfg, bundle)
 
     # Warmup pass: run every measured round once so each distinct cohort
@@ -85,14 +85,13 @@ def main():
 
     # Real images trained in the measured period (padding steps are masked
     # no-ops and do not count), plus the padded count for the curious.
-    n_pad = ds.train_x.shape[1]
-    counts = np.asarray(ds.train_counts)
+    # round_counts reports the same plan run_round executed — one source
+    # of truth for the throughput accounting.
     real_images = padded_images = 0
     for r in range(1, rounds + 1):
-        sampled = sample_clients(r, NUM_CLIENTS, cohort, seed=0)
-        real_images += int(counts[sampled].sum()) * EPOCHS
-        b = api._round_bucket(sampled, None)
-        padded_images += cohort * (n_pad if b is None else b) * EPOCHS
+        real, padded = api.round_counts(r)
+        real_images += real * EPOCHS
+        padded_images += padded * EPOCHS
 
     img_per_sec = real_images / dt
     rounds_per_sec = rounds / dt

@@ -223,13 +223,16 @@ class FedAvgAPI:
         bucket = int(np.ceil(max(maxc, 1.0) / q) * q)
         return None if bucket >= n_pad else bucket
 
-    def _sample_failures(self, round_idx: int, cohort: int) -> Optional[np.ndarray]:
+    def _sample_failures(self, round_idx: int, cohort: int,
+                         record: bool = True) -> Optional[np.ndarray]:
         """Deterministic per-round fault injection (SURVEY.md §5.3: the
         reference has NO failure detection or fault injection — its only
         failure handling is MPI.Abort). With ``config.failure_prob`` > 0
         each sampled client independently fails this round; the aggregation
         then runs elastically over the survivors. Returns a {0,1} live
-        vector or None when injection is off."""
+        vector or None when injection is off. ``record=False`` computes the
+        same deterministic outcome without logging/history side effects
+        (for :meth:`round_counts`)."""
         p = self.config.failure_prob
         if not p:
             return None
@@ -245,25 +248,49 @@ class FedAvgAPI:
             return None
         rng = np.random.default_rng([self.config.seed, 0x0F41, round_idx])
         live = (rng.random(cohort) >= p).astype(np.float32)
-        n_failed = int(cohort - live.sum())
-        if n_failed:
-            log.info("round %d: %d/%d clients failed (injected)",
-                     round_idx, n_failed, cohort)
-        self.history.setdefault("failed_clients", []).append(n_failed)
+        if record:
+            n_failed = int(cohort - live.sum())
+            if n_failed:
+                log.info("round %d: %d/%d clients failed (injected)",
+                         round_idx, n_failed, cohort)
+            self.history.setdefault("failed_clients", []).append(n_failed)
         return live
 
-    # -- driver --------------------------------------------------------------
-
-    def run_round(self, round_idx: int) -> float:
+    def _round_plan(self, round_idx: int, record: bool = False):
+        """The deterministic per-round plan: (sampled cohort, live mask,
+        scan bucket). run_round executes exactly this plan; round_counts
+        reports it — one source of truth for what a round trains on."""
         c = self.config
         sampled = sample_clients(round_idx, self.dataset.num_clients
                                  if c.client_num_in_total > self.dataset.num_clients
                                  else c.client_num_in_total,
                                  min(c.client_num_per_round, self.dataset.num_clients),
                                  seed=c.seed)
-        rk = round_key(self.root_key, round_idx)
-        live = self._sample_failures(round_idx, len(sampled))
+        live = self._sample_failures(round_idx, len(sampled), record=record)
         bucket = self._round_bucket(sampled, live)
+        return sampled, live, bucket
+
+    def round_counts(self, round_idx: int) -> tuple:
+        """(real, padded) training examples one epoch of this round
+        processes: real = the live cohort's actual record counts (masked
+        padding excluded; failed clients' work is discarded by aggregation,
+        so it isn't "real" training), padded = full cohort x static scan
+        length — the device EXECUTES every sampled client's scan slots even
+        when failure injection later zeroes their weight. Used by bench.py
+        so throughput accounting can never drift from run_round."""
+        sampled, live, bucket = self._round_plan(round_idx)
+        counts = np.asarray(self.dataset.train_counts, np.float64)[sampled]
+        if live is not None:
+            counts = counts * live
+        n_pad = int(self.dataset.train_x.shape[1])
+        per = n_pad if bucket is None else bucket
+        return int(counts.sum()), int(per * len(sampled))
+
+    # -- driver --------------------------------------------------------------
+
+    def run_round(self, round_idx: int) -> float:
+        sampled, live, bucket = self._round_plan(round_idx, record=True)
+        rk = round_key(self.root_key, round_idx)
         if self._dev_train is not None:
             live_v = (jnp.ones((len(sampled),), jnp.float32) if live is None
                       else jnp.asarray(live))

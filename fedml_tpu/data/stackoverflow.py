@@ -104,7 +104,9 @@ def _load_so_lr_h5(data_dir: str, client_num: int, batch_size: int) -> FedDatase
     if os.path.exists(test_h5):
         ex_list, ey_list = [], []
         for toks, titles, tg in _h5_client_examples(test_h5, client_num):
-            ex_list.append(np.stack([_bag_of_words(f"{a} {b}", vocab) for a, b in zip(toks, titles)]))
+            ex_list.append(np.stack(
+                [_bag_of_words(" ".join(p for p in (a, b) if p), vocab)
+                 for a, b in zip(toks, titles)]))
             ey_list.append(np.stack([_multi_hot_tags(t, tags) for t in tg]))
         pool_x, pool_y = np.concatenate(ex_list), np.concatenate(ey_list)
     else:

@@ -1,0 +1,204 @@
+"""Pipeline (inter-layer model) parallelism for the transformer LM.
+
+The reference's only pipeline cut is the 2-stage SplitNN activation relay
+(split_nn/client.py:24-34, server.py:40-60 — per-batch acts/grads over
+MPI). The TPU-native generalisation is an N-stage GPipe schedule expressed
+inside ONE jitted program over a ('dp', 'pp') mesh:
+
+- the L transformer blocks are stacked on a leading [L] axis and that axis
+  is sharded over 'pp' — each device stores and runs ``L / S`` blocks;
+- a microbatched forward runs ``M + S - 1`` ticks of ``lax.scan``; every
+  tick each stage applies its blocks to its current slot and hands the
+  activation to the next stage with a single ``ppermute`` hop (ICI
+  neighbour traffic, no host round-trips — the whole schedule is one XLA
+  program, unlike the reference's one-message-per-microbatch protocol);
+- embeddings/head stay replicated: embedding gradients flow only on stage
+  0 and head gradients only on stage S-1 (everything else is masked out of
+  the loss), so a final psum over 'pp' reconstructs full replicated grads;
+- backward is just ``jax.grad`` through the scan — ``ppermute``'s
+  transpose is the reverse rotation, so XLA derives the 1F1B-style reverse
+  schedule automatically.
+
+Exactness: with the same params/batch, loss and the updated params equal
+the single-device step to float tolerance (tested in
+tests/test_pipeline.py) — the pipeline only reorders compute.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import flax.linen as nn
+
+
+def pp_mesh(n_dp: int, n_pp: int) -> Mesh:
+    """2-D (dp, pp) mesh: batch over dp, layer stages over pp."""
+    devs = jax.devices()
+    need = n_dp * n_pp
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:need]).reshape(n_dp, n_pp), ("dp", "pp"))
+
+
+def stack_pipeline_params(variables, layers: int):
+    """Regroup TransformerLM params: per-block subtrees ``block0..block{L-1}``
+    stack onto a leading [L] axis (shardable over 'pp'); everything else
+    (embeddings, final LayerNorm, lm_head) goes to a replicated 'outer'."""
+    outer = dict(variables["params"])
+    blocks = [outer.pop(f"block{i}") for i in range(layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return {"outer": outer, "blocks": stacked}
+
+
+def unstack_pipeline_params(pp_params, layers: int):
+    """Inverse of :func:`stack_pipeline_params` → TransformerLM variables."""
+    params = dict(pp_params["outer"])
+    for i in range(layers):
+        params[f"block{i}"] = jax.tree_util.tree_map(
+            lambda x, i=i: x[i], pp_params["blocks"])
+    return {"params": params}
+
+
+#: shard_map / device_put spec prefix for the pipeline param pytree.
+PP_PARAM_SPECS = {"outer": P(), "blocks": P("pp")}
+
+
+def place_pp_params(pp_params, mesh: Mesh):
+    """Put block stacks on their stages, replicate the outer params."""
+    return {
+        "outer": jax.device_put(
+            pp_params["outer"], NamedSharding(mesh, P())),
+        "blocks": jax.device_put(
+            pp_params["blocks"], NamedSharding(mesh, P("pp"))),
+    }
+
+
+def make_pp_lm_train_step(
+    module, tx, mesh: Mesh, *, n_micro: Optional[int] = None,
+    attn_impl: str = "auto",
+) -> Callable:
+    """Build a jitted GPipe train step over a ('dp', 'pp') mesh.
+
+    ``module`` is a TransformerLM (no ring_axis — the sequence stays whole;
+    compose with SP by nesting meshes if both are needed), ``tx`` an optax
+    transformation. Returns ``step(pp_params, opt_state, x, y, mask) ->
+    (pp_params, opt_state, loss)``; ``x/y/mask [B, T]`` shard over 'dp',
+    each dp shard is further split into ``n_micro`` microbatches that flow
+    through the stage ring. ``module.layers`` must divide evenly into
+    ``mesh.shape['pp']`` stages.
+    """
+    from jax import shard_map
+
+    from fedml_tpu.ops.xent import masked_cross_entropy
+
+    S = mesh.shape["pp"]
+    M = n_micro or S
+    if module.layers % S:
+        raise ValueError(f"layers ({module.layers}) not divisible by pp ({S})")
+    if module.dropout:
+        raise ValueError("pipeline step runs eval-mode blocks; dropout "
+                         "must be 0 (reference LMs train without dropout)")
+
+    from fedml_tpu.models.transformer import Block as _Block
+
+    block_mod = _Block(module.dim, module.heads, module.mlp_ratio, 0.0,
+                       attn_impl, dtype=module.dtype)
+
+    def stage_apply(block_params, h):
+        """Run this stage's L/S blocks (stacked leading axis) in order."""
+        def body(h, p):
+            return block_mod.apply({"params": p}, h, False), None
+
+        h, _ = lax.scan(body, h, block_params)
+        return h
+
+    def embed(outer, xm):
+        tok = outer["tok_embed"]["embedding"]
+        pos = outer["pos_embed"]["embedding"]
+        t = xm.shape[-1]
+        h = tok[xm.astype(jnp.int32)] + pos[jnp.arange(t)][None]
+        return h.astype(module.dtype)
+
+    def head(outer, h):
+        h = nn.LayerNorm(dtype=module.dtype).apply(
+            {"params": outer["LayerNorm_0"]}, h)
+        return (h.astype(jnp.float32) @ outer["lm_head"]["kernel"]
+                + outer["lm_head"]["bias"])
+
+    ring = [(i, (i + 1) % S) for i in range(S)]
+
+    def grad_fn(pp_params, x, y, mask):
+        stage = lax.axis_index("pp")
+        last = (stage == S - 1).astype(jnp.float32)
+        # global token count OUTSIDE the differentiated graph: psum's
+        # transpose is psum, so a scalar psum inside loss_fn would scale
+        # every cotangent by the mesh size (same fix as sequence.py).
+        total = lax.psum(last * jnp.sum(mask.astype(jnp.float32)),
+                         ("dp", "pp"))
+
+        def loss_fn(pp_params):
+            outer, blocks = pp_params["outer"], pp_params["blocks"]
+            b, t = x.shape
+            if b % M:
+                raise ValueError(
+                    f"per-dp-shard batch ({b}) not divisible by "
+                    f"n_micro ({M}); pick a global batch that is a "
+                    f"multiple of n_dp * n_micro")
+            mb = b // M
+            xm = x.reshape(M, mb, t)
+            h0 = embed(outer, xm)                      # [M, mb, T, D]
+            state0 = jnp.zeros_like(h0[0])
+            ys0 = jnp.zeros_like(h0)
+
+            def tick(carry, tk):
+                state, ys = carry
+                inp = h0[jnp.minimum(tk, M - 1)]
+                sin = jnp.where(stage == 0, inp, state)
+                out = stage_apply(blocks, sin)
+                oidx = jnp.clip(tk - (S - 1), 0, M - 1)
+                write = (stage == S - 1) & (tk >= S - 1)
+                cur = lax.dynamic_index_in_dim(ys, oidx, 0, keepdims=False)
+                ys = lax.dynamic_update_index_in_dim(
+                    ys, jnp.where(write, out, cur), oidx, 0)
+                nxt = lax.ppermute(out, "pp", ring)
+                return (nxt, ys), None
+
+            (_, ys), _ = lax.scan(tick, (state0, ys0),
+                                  jnp.arange(M + S - 1))
+            logits = head(outer, ys.reshape(b, t, -1))
+            per = masked_cross_entropy(logits, y, mask, impl="xla")
+            return last * jnp.sum(per) / jnp.maximum(total, 1.0)
+
+        local_loss, grads = jax.value_and_grad(loss_fn)(pp_params)
+        loss = lax.psum(local_loss, ("dp", "pp"))
+        # local_loss divides by the GLOBAL token count, so grads are per-device
+        # contributions: outer grads live only on their owning stage (embed
+        # on 0, head on S-1) — sum over 'pp' replicates them; block grads
+        # stay stage-local (their [L/S] shard IS the full grad) and only
+        # sum over 'dp'.
+        return loss, {
+            "outer": lax.psum(grads["outer"], ("dp", "pp")),
+            "blocks": lax.psum(grads["blocks"], "dp"),
+        }
+
+    grad_shard = shard_map(
+        grad_fn, mesh=mesh,
+        in_specs=(PP_PARAM_SPECS, P("dp"), P("dp"), P("dp")),
+        out_specs=(P(), PP_PARAM_SPECS),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(pp_params, opt_state, x, y, mask):
+        loss, grads = grad_shard(pp_params, x, y, mask)
+        updates, new_opt = tx.update(grads, opt_state, pp_params)
+        return optax.apply_updates(pp_params, updates), new_opt, loss
+
+    return step

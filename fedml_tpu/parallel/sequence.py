@@ -166,6 +166,11 @@ def make_sp_lm_train_step(
     def local_step(variables, opt_state, x, y, mask, rng):
         tl = x.shape[1]                      # local seq shard length
         pos_off = jax.lax.axis_index("sp") * tl
+        # global token count, computed OUTSIDE the differentiated graph: a
+        # scalar psum inside loss_fn would transpose to another psum and
+        # scale every cotangent by the mesh size (8x grads on an 8-device
+        # mesh — exactness-tested against the single-device step).
+        total = jax.lax.psum(jnp.sum(mask.astype(jnp.float32)), ("dp", "sp"))
 
         def loss_fn(params):
             vars_in = dict(variables)
@@ -176,14 +181,12 @@ def make_sp_lm_train_step(
 
             per = masked_cross_entropy(logits, y, mask, impl=attn_impl,
                                        interpret=interpret)
-            local_sum = jnp.sum(per)
-            local_cnt = jnp.sum(mask.astype(jnp.float32))
-            total = jax.lax.psum(local_cnt, ("dp", "sp"))
-            return jax.lax.psum(local_sum, ("dp", "sp")) / jnp.maximum(total, 1.0)
+            return jnp.sum(per) / jnp.maximum(total, 1.0)
 
-        loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
-        # loss already divides by the GLOBAL token count, so each device's
+        local_loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+        # local_loss divides by the GLOBAL token count, so each device's
         # grad is its local contribution to the true mean — sum, not mean.
+        loss = jax.lax.psum(local_loss, ("dp", "sp"))
         grads = jax.lax.psum(grads, ("dp", "sp"))
         import optax
 

@@ -253,6 +253,49 @@ class TestTransformer:
             new_vars["params"], params_before)
         assert max(jax.tree.leaves(moved)) > 0
 
+    def test_sp_training_step_grads_match_single_device(self):
+        """The SP step's UPDATE must equal the single-device step's update
+        (regression: a scalar psum inside the differentiated loss transposes
+        to another psum and scales grads by the mesh size)."""
+        import optax
+
+        from fedml_tpu.models.transformer import TransformerLM
+        from fedml_tpu.parallel.sequence import make_sp_lm_train_step, sp_mesh
+        from fedml_tpu.ops.xent import masked_cross_entropy
+
+        vocab, b, t = 50, 4, 32
+        mesh = sp_mesh(2, 4)
+        mod_sp = TransformerLM(vocab_size=vocab, dim=32, heads=2, layers=2,
+                               max_len=t, attn_impl="xla",
+                               ring_axis="sp", ring_size=4)
+        mod_ref = TransformerLM(vocab_size=vocab, dim=32, heads=2, layers=2,
+                                max_len=t, attn_impl="xla")
+        rngd = np.random.default_rng(7)
+        x = jnp.asarray(rngd.integers(0, vocab, size=(b, t)), jnp.int32)
+        y = jnp.asarray(rngd.integers(0, vocab, size=(b, t)), jnp.int32)
+        mask = jnp.asarray(rngd.random((b, t)) < 0.9, jnp.float32)
+
+        variables = mod_ref.init(jax.random.key(0), x[:1])
+        tx = optax.sgd(0.1)
+
+        def ref_loss_fn(params):
+            logits = mod_ref.apply({"params": params}, x)
+            per = masked_cross_entropy(logits, y, mask, impl="xla")
+            return jnp.sum(per) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        grads = jax.grad(ref_loss_fn)(variables["params"])
+        upd, _ = tx.update(grads, tx.init(variables["params"]))
+        ref_params = optax.apply_updates(variables["params"], upd)
+
+        step = make_sp_lm_train_step(mod_sp, tx, mesh, attn_impl="xla")
+        new_vars, _, _ = step(
+            jax.tree.map(jnp.array, variables),
+            tx.init(variables["params"]), x, y, mask, jax.random.key(1))
+        jax.tree_util.tree_map(
+            lambda a, r: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(r), rtol=2e-4, atol=2e-5),
+            new_vars["params"], ref_params)
+
 
 class TestUlyssesAttention:
     """All-to-all (Ulysses) sequence parallelism must be exact — identical to
