@@ -64,6 +64,7 @@ class FedAvgAPI:
         self._round_step = self.build_round_step()
         self._dev_train = self._maybe_place_train_data()
         self._gather_steps: dict[int, Callable] = {}
+        self._group_steps: dict[tuple, Callable] = {}
         if self._dev_train is not None:
             self._round_step_gather = self.build_round_step_gather()
         self.history: dict[str, list] = {"round": [], "Test/Acc": [], "Test/Loss": []}
@@ -161,6 +162,12 @@ class FedAvgAPI:
         res = jax.vmap(self._local_train, in_axes=(None, 0, 0, 0, 0, 0))(
             variables, cx, cy, cm, counts, jax.random.split(rng, cx.shape[0])
         )
+        return self._finish_round(variables, server_state, res, counts, rng)
+
+    def _finish_round(self, variables, server_state, res, counts, rng):
+        """Aggregate the cohort's local results + elastic-round guard +
+        weighted train loss (shared by the single- and multi-group round
+        programs)."""
         new_vars, new_state = self.aggregate(
             variables, res.variables, counts, res, rng, server_state
         )
@@ -223,6 +230,81 @@ class FedAvgAPI:
         bucket = int(np.ceil(max(maxc, 1.0) / q) * q)
         return None if bucket >= n_pad else bucket
 
+    def _round_groups(self, sampled: np.ndarray, live: Optional[np.ndarray]):
+        """Multi-group schedule (config.bucket_groups > 1): sort the cohort
+        by real count and split it into up to ``bucket_groups`` contiguous
+        groups, each with its own quantum-rounded scan length. A single
+        scan length must cover the cohort's LARGEST client, so small
+        clients burn (max - count) masked padding steps; per-group scan
+        lengths cut that waste while computing the exact same weighted
+        aggregate (group order is irrelevant to a weighted mean).
+
+        Returns None (schedule degenerates to the single-bucket path) or
+        ``(perm, groups)``: ``perm`` sorts cohort positions by count,
+        ``groups`` is a tuple of (size, scan_len) ascending."""
+        c = self.config
+        if c.bucket_groups <= 1 or len(sampled) < 2:
+            return None
+        n_pad = int(self.dataset.train_x.shape[1])
+        q = c.bucket_quantum_batches * c.batch_size
+        if c.bucket_quantum_batches <= 0 or q >= n_pad:
+            return None
+        counts = np.asarray(self.dataset.train_counts, np.float64)[sampled]
+        if live is not None:
+            counts = counts * live
+        perm = np.argsort(counts, kind="stable")
+        sc = counts[perm]
+        G = min(c.bucket_groups, len(sampled))
+        bounds = np.linspace(0, len(sampled), G + 1).round().astype(int)
+        groups: list[list[int]] = []
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            if a == b:
+                continue
+            bucket = min(int(np.ceil(max(float(sc[b - 1]), 1.0) / q) * q), n_pad)
+            if groups and groups[-1][1] == bucket:
+                groups[-1][0] += b - a        # merge equal scan lengths
+            else:
+                groups.append([b - a, bucket])
+        if len(groups) == 1:
+            # degenerate schedule: one shared scan length — the single-bucket
+            # path computes the identical program (same bucket via
+            # _round_bucket, same per-position keys), so don't compile a
+            # second copy of it here
+            return None
+        return perm, tuple((s, b) for s, b in groups)
+
+    def build_round_step_gather_groups(self, groups: tuple):
+        """Round step over device-resident data with PER-GROUP scan lengths
+        (see _round_groups). ``idx``/``live`` arrive in group (count-sorted)
+        order; ``pos`` maps each slot back to its original sampled position
+        so every client consumes the same per-round RNG key it would under
+        the single-bucket program (key = split(rng, cohort)[position])."""
+        local_train = self._local_train
+        finish = self._finish_round
+        sizes = [g[0] for g in groups]
+        buckets = [g[1] for g in groups]
+        starts = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(int)
+        cohort = int(sum(sizes))
+
+        @jax.jit
+        def round_step(variables, server_state, tx, ty, tm, tcounts, idx, live, pos, rng):
+            keys = jax.random.split(rng, cohort)[pos]
+            parts = []
+            for start, size, bucket in zip(starts, sizes, buckets):
+                sl = slice(start, start + size)
+                idx_g = idx[sl]
+                cx = jnp.take(tx, idx_g, axis=0)[:, :bucket]
+                cy = jnp.take(ty, idx_g, axis=0)[:, :bucket]
+                cm = jnp.take(tm, idx_g, axis=0)[:, :bucket]
+                cnt_g = jnp.take(tcounts, idx_g, axis=0) * live[sl]
+                parts.append(jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+                    variables, cx, cy, cm, cnt_g, keys[sl]))
+            res = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+            counts = jnp.take(tcounts, idx, axis=0) * live
+            return finish(variables, server_state, res, counts, rng)
+
+        return round_step
+
     def _sample_failures(self, round_idx: int, cohort: int,
                          record: bool = True) -> Optional[np.ndarray]:
         """Deterministic per-round fault injection (SURVEY.md §5.3: the
@@ -283,8 +365,12 @@ class FedAvgAPI:
         if live is not None:
             counts = counts * live
         n_pad = int(self.dataset.train_x.shape[1])
-        per = n_pad if bucket is None else bucket
-        return int(counts.sum()), int(per * len(sampled))
+        plan = self._round_groups(sampled, live) if self._dev_train is not None else None
+        if plan is not None:
+            padded = sum(s * b for s, b in plan[1])
+        else:
+            padded = (n_pad if bucket is None else bucket) * len(sampled)
+        return int(counts.sum()), int(padded)
 
     # -- driver --------------------------------------------------------------
 
@@ -292,8 +378,22 @@ class FedAvgAPI:
         sampled, live, bucket = self._round_plan(round_idx, record=True)
         rk = round_key(self.root_key, round_idx)
         if self._dev_train is not None:
-            live_v = (jnp.ones((len(sampled),), jnp.float32) if live is None
-                      else jnp.asarray(live))
+            live_np = (np.ones((len(sampled),), np.float32) if live is None
+                       else np.asarray(live, np.float32))
+            plan = self._round_groups(sampled, live)
+            if plan is not None:
+                perm, groups = plan
+                step = self._group_steps.get(groups)
+                if step is None:
+                    step = self._group_steps[groups] = \
+                        self.build_round_step_gather_groups(groups)
+                self.variables, self.server_state, train_loss = step(
+                    self.variables, self.server_state, *self._dev_train,
+                    jnp.asarray(sampled[perm], jnp.int32),
+                    jnp.asarray(live_np[perm]),
+                    jnp.asarray(perm, jnp.int32), rk
+                )
+                return float(train_loss)
             if bucket is None:
                 step = self._round_step_gather
             else:
@@ -302,7 +402,7 @@ class FedAvgAPI:
                     step = self._gather_steps[bucket] = self.build_round_step_gather(bucket)
             self.variables, self.server_state, train_loss = step(
                 self.variables, self.server_state, *self._dev_train,
-                jnp.asarray(sampled, jnp.int32), live_v, rk
+                jnp.asarray(sampled, jnp.int32), jnp.asarray(live_np), rk
             )
         else:
             cx, cy, cm, counts = self.dataset.client_slice(sampled)

@@ -100,6 +100,14 @@ class FedConfig:
     # than an unbucketed run — same distribution, different trajectory.
     # Runs are still deterministic per (seed, config).
     bucket_quantum_batches: int = 8
+    # Split the sampled cohort into up to this many count-sorted groups,
+    # each with its own (quantum-rounded) scan length, inside ONE round
+    # program — small clients stop paying the largest client's padding
+    # steps. 1 = single shared scan length (the bucket above). Same
+    # weighted aggregate either way (group order is irrelevant to it);
+    # like bucketing itself, the truncated shuffle stream changes the
+    # trajectory, not the distribution. Device-resident (gather) path only.
+    bucket_groups: int = 1
 
     # observability
     run_name: str = "fedml_tpu"
@@ -128,6 +136,8 @@ class FedConfig:
             raise ValueError(f"dtype must be float32|bfloat16, got {self.dtype!r}")
         if self.device_data not in ("auto", "on", "off"):
             raise ValueError(f"device_data must be auto|on|off, got {self.device_data!r}")
+        if self.bucket_groups < 1:
+            raise ValueError(f"bucket_groups must be >= 1, got {self.bucket_groups}")
         if self.checkpoint_frequency < 1:
             raise ValueError(
                 f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"
@@ -210,6 +220,7 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                    default=defaults.device_data_max_bytes)
     p.add_argument("--bucket_quantum_batches", type=int,
                    default=defaults.bucket_quantum_batches)
+    p.add_argument("--bucket_groups", type=int, default=defaults.bucket_groups)
     p.add_argument("--run_name", type=str, default=defaults.run_name)
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)

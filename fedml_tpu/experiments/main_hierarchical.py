@@ -1,0 +1,9 @@
+"""Thin alias of the unified launcher (reference fedml_experiments pattern:
+one main per algorithm). Equivalent to --algorithm hierarchical."""
+
+import sys
+
+from fedml_tpu.experiments.run import main
+
+if __name__ == "__main__":
+    main(sys.argv[1:], default_algorithm="hierarchical")

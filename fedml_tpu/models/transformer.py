@@ -98,6 +98,7 @@ class TransformerLM(nn.Module):
     ring_axis: Optional[str] = None     # set to 'sp' for sequence parallelism
     ring_size: int = 1
     sp_mode: str = "ring"               # ring (ppermute) | ulysses (all-to-all)
+    remat: bool = False                 # rematerialize blocks on backward
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -108,10 +109,16 @@ class TransformerLM(nn.Module):
         pos = pos_offset + jnp.arange(t)
         h = h + nn.Embed(self.max_len, self.dim, dtype=self.dtype,
                          name="pos_embed")(pos)[None]
+        # remat: drop each block's activations on the forward pass and
+        # recompute them during backward — long-context training is HBM-bound
+        # on activations (B x T x D per layer), and the recompute rides the
+        # MXU headroom the small per-block matmuls leave anyway.
+        block_cls = (nn.remat(Block, static_argnums=(2,)) if self.remat
+                     else Block)
         for i in range(self.layers):
-            h = Block(self.dim, self.heads, self.mlp_ratio, self.dropout,
-                      self.attn_impl, self.ring_axis, self.ring_size,
-                      self.sp_mode, self.dtype, name=f"block{i}")(h, train)
+            h = block_cls(self.dim, self.heads, self.mlp_ratio, self.dropout,
+                          self.attn_impl, self.ring_axis, self.ring_size,
+                          self.sp_mode, self.dtype, name=f"block{i}")(h, train)
         h = nn.LayerNorm(dtype=self.dtype)(h)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(h)
 
@@ -125,6 +132,7 @@ def _bundle(name, vocab, seq_len, **kw):
                            ring_axis=kw.pop("ring_axis", None),
                            ring_size=kw.pop("ring_size", 1),
                            sp_mode=kw.pop("sp_mode", "ring"),
+                           remat=kw.pop("remat", False),
                            dtype=kw.pop("dtype", jnp.float32), **sizes)
     return ModelBundle(
         name=name, module=module, input_shape=(seq_len,),
