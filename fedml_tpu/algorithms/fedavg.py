@@ -356,10 +356,11 @@ class FedAvgAPI:
         """(real, padded) training examples one epoch of this round
         processes: real = the live cohort's actual record counts (masked
         padding excluded; failed clients' work is discarded by aggregation,
-        so it isn't "real" training), padded = full cohort x static scan
-        length — the device EXECUTES every sampled client's scan slots even
-        when failure injection later zeroes their weight. Used by bench.py
-        so throughput accounting can never drift from run_round."""
+        so it isn't "real" training), padded = the scan slots the device
+        EXECUTES — every sampled client counts (failure injection only
+        zeroes weights), at the shared scan length, or per-group
+        size x scan_len when bucket_groups schedules apply. Used by
+        bench.py so throughput accounting can never drift from run_round."""
         sampled, live, bucket = self._round_plan(round_idx)
         counts = np.asarray(self.dataset.train_counts, np.float64)[sampled]
         if live is not None:
@@ -385,6 +386,11 @@ class FedAvgAPI:
                 perm, groups = plan
                 step = self._group_steps.get(groups)
                 if step is None:
+                    # bound the compile cache: with failure injection the
+                    # live mask varies the group tuple round to round and
+                    # the key space is large — evict oldest-compiled first
+                    if len(self._group_steps) >= 64:
+                        self._group_steps.pop(next(iter(self._group_steps)))
                     step = self._group_steps[groups] = \
                         self.build_round_step_gather_groups(groups)
                 self.variables, self.server_state, train_loss = step(

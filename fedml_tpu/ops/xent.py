@@ -83,13 +83,19 @@ def _pallas_xent(logits, labels, block_n: int, block_v: int, interpret: bool):
         grid=(n // bn,),
         in_specs=[
             pl.BlockSpec((bn, v), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bn), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            # labels ride as [n/bn, 1, bn] so the block's trailing dims
+            # (1, bn) EQUAL the array's — TPU lowering requires trailing
+            # block dims divisible by (8, 128) or exactly the array dims
+            # (a (1, bn) block over a [n/bn, bn] array fails that check;
+            # interpret mode never enforces it)
+            pl.BlockSpec((1, 1, bn), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, bn, 128), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n // bn, bn, 128), jnp.float32),
         interpret=interpret,
-    )(logits, labels.astype(jnp.int32).reshape(n // bn, bn))
+    )(logits, labels.astype(jnp.int32).reshape(n // bn, 1, bn))
     return out[..., 0].reshape(n)
 
 
