@@ -61,53 +61,68 @@ def _xla_block_partial(q, k, v, q_offset, k_offset, causal, sm_scale):
 # ---------------------------------------------------------------------------
 
 def _flash_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
-                  o_ref, m_ref, l_ref, *,
-                  block_k: int, causal: bool, sm_scale: float, block_q: int):
-    """Grid point = (batch*heads, q_block). K/V chunk is fully resident; the
-    kernel streams it in block_k slices with an online softmax (running
-    rowmax m / rowsum l), accumulating the UNNORMALIZED output."""
+                  o_ref, m_ref, l_ref, m_s, l_s, acc_s, *,
+                  causal: bool, sm_scale: float,
+                  block_q: int, block_k: int, nk: int):
+    """Grid point = (batch*heads, q_block, k_block) with the k dimension
+    'arbitrary' (sequential): running rowmax/rowsum/accumulator live in
+    VMEM scratch across the k sweep, so VMEM holds only one (bq, d) query
+    tile and one (bk, d) K/V tile at a time — sequence length is bounded
+    by HBM, not by VMEM (the previous full-K/V-resident block spec OOMed
+    scoped vmem at T=8192)."""
     import jax.experimental.pallas as pl
 
     qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                          # [bq, D]
-    tk = k_ref.shape[1]
-    nk = tk // block_k
+    kb = pl.program_id(2)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
 
-    def body(i, carry):
-        m, l, acc = carry
-        kblk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+    q_start = qoff_ref[0] + qb * block_q
+    k_start = koff_ref[0] + kb * block_k
+    # causal: skip k blocks entirely above the diagonal (their mask is all
+    # -inf); scratch then carries through unchanged.
+    live = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)                      # [bq, D]
+        kblk = k_ref[0].astype(jnp.float32)                   # [bk, D]
+        vblk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale                                          # [bq, bk]
         if causal:
-            qpos = qoff_ref[0] + qb * block_q + jax.lax.broadcasted_iota(
+            qpos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = koff_ref[0] + i * block_k + jax.lax.broadcasted_iota(
+            kpos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_prev = m_s[:, :1]                                   # [bq, 1]
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        alpha = jnp.exp(m - m_new)
-        alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
             p, vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l, acc
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
-    o_ref[0] = acc
-    # m/l are [bq, 1] — broadcast across the 128-lane dim of their outputs
-    m_ref[0] = jnp.broadcast_to(m, (block_q, 128))
-    l_ref[0] = jnp.broadcast_to(l, (block_q, 128))
+    @pl.when(kb == nk - 1)
+    def _emit():
+        o_ref[0] = acc_s[...]
+        # m/l are row-broadcast across the 128-lane dim of their outputs
+        m_ref[0] = m_s[...]
+        l_ref[0] = l_s[...]
 
 
 def _pallas_block_partial(q, k, v, q_offset, k_offset, causal, sm_scale,
@@ -128,15 +143,14 @@ def _pallas_block_partial(q, k, v, q_offset, k_offset, causal, sm_scale,
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
 
-    grid = (b * h, tq // bq)
+    nk = tk // bk
+    grid = (b * h, tq // bq, nk)
     kernel = functools.partial(
-        _flash_kernel, block_k=bk, causal=causal, sm_scale=sm_scale, block_q=bq)
-    try:
-        from jax.experimental.pallas import tpu as pltpu
-        smem = pltpu.SMEM
-        vmem = pltpu.VMEM
-    except ImportError:  # pragma: no cover
-        smem = vmem = None
+        _flash_kernel, causal=causal, sm_scale=sm_scale,
+        block_q=bq, block_k=bk, nk=nk)
+    from jax.experimental.pallas import tpu as pltpu
+    smem = pltpu.SMEM
+    vmem = pltpu.VMEM
 
     def spec(block, index_map):
         return pl.BlockSpec(block, index_map, memory_space=vmem)
@@ -147,20 +161,28 @@ def _pallas_block_partial(q, k, v, q_offset, k_offset, causal, sm_scale,
         in_specs=[
             pl.BlockSpec(memory_space=smem),
             pl.BlockSpec(memory_space=smem),
-            spec((1, bq, d), lambda bh, qb: (bh, qb, 0)),
-            spec((1, tk, d), lambda bh, qb: (bh, 0, 0)),
-            spec((1, tk, d), lambda bh, qb: (bh, 0, 0)),
+            spec((1, bq, d), lambda bh, qb, kb: (bh, qb, 0)),
+            spec((1, bk, d), lambda bh, qb, kb: (bh, kb, 0)),
+            spec((1, bk, d), lambda bh, qb, kb: (bh, kb, 0)),
         ],
         out_specs=[
-            spec((1, bq, d), lambda bh, qb: (bh, qb, 0)),
-            spec((1, bq, 128), lambda bh, qb: (bh, qb, 0)),
-            spec((1, bq, 128), lambda bh, qb: (bh, qb, 0)),
+            spec((1, bq, d), lambda bh, qb, kb: (bh, qb, 0)),
+            spec((1, bq, 128), lambda bh, qb, kb: (bh, qb, 0)),
+            spec((1, bq, 128), lambda bh, qb, kb: (bh, qb, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
             jax.ShapeDtypeStruct((b * h, tq, 128), jnp.float32),
             jax.ShapeDtypeStruct((b * h, tq, 128), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running rowmax
+            pltpu.VMEM((bq, 128), jnp.float32),   # running rowsum
+            pltpu.VMEM((bq, d), jnp.float32),     # unnormalized output
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
         interpret=interpret,
     )(qoff, koff, qr, kr, vr)
     return (o.reshape(b, h, tq, d),
