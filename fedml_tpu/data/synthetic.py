@@ -45,7 +45,10 @@ def make_synthetic_lr(
         B_k = rng.normal(0, beta)
         v_k = rng.normal(B_k, 1, dim)
         n = int(sizes[k]) + 8  # extra records become the test split
-        x = rng.normal(v_k, 1, (n, dim)) * np.sqrt(diag)
+        # x ~ N(v_k, Sigma): the diagonal covariance scales the NOISE only
+        # (scaling the mean too would shrink the inter-client signal in
+        # later feature dims and make the task much harder than LEAF's)
+        x = v_k + rng.normal(0, 1, (n, dim)) * np.sqrt(diag)
         y = np.argmax(x @ W + b, axis=1)
         xs.append(x[:-8].astype(np.float32)); ys.append(y[:-8].astype(np.int32))
         test_xs.append(x[-8:].astype(np.float32)); test_ys.append(y[-8:].astype(np.int32))

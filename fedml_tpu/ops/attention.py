@@ -181,7 +181,9 @@ def _pallas_block_partial(q, k, v, q_offset, k_offset, causal, sm_scale,
             pltpu.VMEM((bq, d), jnp.float32),     # unnormalized output
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            # only the kb sweep carries scratch state (re-initialized at
+            # kb==0), so bh and qb may split across Megacore cores
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(qoff, koff, qr, kr, vr)

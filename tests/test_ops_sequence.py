@@ -68,6 +68,31 @@ class TestAttention:
         ref = naive_attention(q, k, v, causal=True)
         np.testing.assert_allclose(out, ref, atol=1e-5)
 
+    def test_pallas_offsets_match_chunked_reference(self):
+        """The kernel's q/k offsets (what ring attention feeds it) and its
+        causal block-skip path: chunked pallas partials with nonzero
+        k_offset must merge to the one-shot result, including a fully
+        future (dead) chunk."""
+        q, k, v = _qkv(t=64)
+        n_chunks, tc = 4, 16
+        acc = None
+        for i in range(n_chunks):
+            part = attention_block_partial(
+                q, k[:, :, i * tc:(i + 1) * tc], v[:, :, i * tc:(i + 1) * tc],
+                q_offset=0, k_offset=i * tc, causal=True, impl="pallas",
+                interpret=True, block_q=32, block_k=8)
+            acc = part if acc is None else merge_partials(acc, part)
+        out = normalize_partial(*acc)
+        ref = naive_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+        # shifted query window: q rows 32..63 against the full K/V
+        part = attention_block_partial(
+            q[:, :, 32:], k, v, q_offset=32, k_offset=0, causal=True,
+            impl="pallas", interpret=True, block_q=16, block_k=16)
+        out2 = normalize_partial(*part)
+        np.testing.assert_allclose(out2, ref[:, :, 32:], atol=1e-4)
+
     def test_grad_flows(self):
         q, k, v = _qkv(t=32, d=16)
 

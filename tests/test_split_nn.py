@@ -19,7 +19,7 @@ def test_splitnn_fused_learns(small_ds):
     from fedml_tpu.algorithms.split_nn import SplitNNAPI
 
     ds = small_ds
-    cfg = FedConfig(batch_size=10, lr=0.1, momentum=0.9, epochs=1, comm_round=3, seed=0)
+    cfg = FedConfig(batch_size=10, lr=0.02, momentum=0.9, epochs=1, comm_round=3, seed=0)
     client_b, server_b = create_split_mlp(ds.class_num, ds.train_x.shape[2:], cut_dim=32)
     api = SplitNNAPI(ds, cfg, client_b, server_b)
     hist = api.train()
