@@ -119,4 +119,17 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # The TPU-tunnel compile service occasionally drops a long compile
+    # (transient INTERNAL/remote_compile errors); one retry after a pause
+    # rides through it rather than losing the whole bench run.
+    try:
+        main()
+    except Exception as e:
+        if not any(s in str(e) for s in ("INTERNAL", "remote_compile",
+                                         "DEADLINE", "UNAVAILABLE")):
+            raise
+        import traceback
+
+        traceback.print_exc()
+        time.sleep(30)
+        main()

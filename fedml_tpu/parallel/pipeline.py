@@ -80,25 +80,19 @@ def place_pp_params(pp_params, mesh: Mesh):
     }
 
 
-def make_pp_lm_train_step(
-    module, tx, mesh: Mesh, *, n_micro: Optional[int] = None,
-    attn_impl: str = "auto",
-) -> Callable:
-    """Build a jitted GPipe train step over a ('dp', 'pp') mesh.
-
-    ``module`` is a TransformerLM (no ring_axis — the sequence stays whole;
-    compose with SP by nesting meshes if both are needed), ``tx`` an optax
-    transformation. Returns ``step(pp_params, opt_state, x, y, mask) ->
-    (pp_params, opt_state, loss)``; ``x/y/mask [B, T]`` shard over 'dp',
-    each dp shard is further split into ``n_micro`` microbatches that flow
-    through the stage ring. ``module.layers`` must divide evenly into
-    ``mesh.shape['pp']`` stages.
-    """
+def _make_pp_step(module, tx, mesh: Mesh, n_micro: Optional[int],
+                  attn_impl: str, sp_axis: Optional[str], sp_mode: str):
+    """Shared GPipe schedule builder. With ``sp_axis=None`` this is plain
+    (dp, pp); with ``sp_axis='sp'`` every activation tile is additionally
+    sequence-sharded and each Block runs ring/Ulysses attention over that
+    axis — the 2-D step is exactly the n_sp=1 case."""
     from jax import shard_map
 
+    from fedml_tpu.models.transformer import Block as _Block
     from fedml_tpu.ops.xent import masked_cross_entropy
 
     S = mesh.shape["pp"]
+    n_sp = mesh.shape[sp_axis] if sp_axis else 1
     M = n_micro or S
     if module.layers % S:
         raise ValueError(f"layers ({module.layers}) not divisible by pp ({S})")
@@ -106,10 +100,12 @@ def make_pp_lm_train_step(
         raise ValueError("pipeline step runs eval-mode blocks; dropout "
                          "must be 0 (reference LMs train without dropout)")
 
-    from fedml_tpu.models.transformer import Block as _Block
-
     block_mod = _Block(module.dim, module.heads, module.mlp_ratio, 0.0,
-                       attn_impl, dtype=module.dtype)
+                       attn_impl,
+                       sp_axis if n_sp > 1 else None, n_sp, sp_mode,
+                       dtype=module.dtype)
+    axes = ("dp", "pp") + ((sp_axis,) if sp_axis else ())
+    block_axes = ("dp",) + ((sp_axis,) if sp_axis else ())
 
     def stage_apply(block_params, h):
         """Run this stage's L/S blocks (stacked leading axis) in order."""
@@ -119,11 +115,11 @@ def make_pp_lm_train_step(
         h, _ = lax.scan(body, h, block_params)
         return h
 
-    def embed(outer, xm):
+    def embed(outer, xm, pos_start):
         tok = outer["tok_embed"]["embedding"]
         pos = outer["pos_embed"]["embedding"]
-        t = xm.shape[-1]
-        h = tok[xm.astype(jnp.int32)] + pos[jnp.arange(t)][None]
+        tl = xm.shape[-1]
+        h = tok[xm.astype(jnp.int32)] + pos[pos_start + jnp.arange(tl)][None]
         return h.astype(module.dtype)
 
     def head(outer, h):
@@ -137,23 +133,23 @@ def make_pp_lm_train_step(
     def grad_fn(pp_params, x, y, mask):
         stage = lax.axis_index("pp")
         last = (stage == S - 1).astype(jnp.float32)
+        pos_start = (lax.axis_index(sp_axis) * x.shape[1]) if sp_axis else 0
         # global token count OUTSIDE the differentiated graph: psum's
         # transpose is psum, so a scalar psum inside loss_fn would scale
         # every cotangent by the mesh size (same fix as sequence.py).
-        total = lax.psum(last * jnp.sum(mask.astype(jnp.float32)),
-                         ("dp", "pp"))
+        total = lax.psum(last * jnp.sum(mask.astype(jnp.float32)), axes)
 
         def loss_fn(pp_params):
             outer, blocks = pp_params["outer"], pp_params["blocks"]
-            b, t = x.shape
+            b, tl = x.shape            # local: batch/dp rows, seq(/sp) tokens
             if b % M:
                 raise ValueError(
                     f"per-dp-shard batch ({b}) not divisible by "
                     f"n_micro ({M}); pick a global batch that is a "
                     f"multiple of n_dp * n_micro")
             mb = b // M
-            xm = x.reshape(M, mb, t)
-            h0 = embed(outer, xm)                      # [M, mb, T, D]
+            xm = x.reshape(M, mb, tl)
+            h0 = embed(outer, xm, pos_start)           # [M, mb, Tl, D]
             state0 = jnp.zeros_like(h0[0])
             ys0 = jnp.zeros_like(h0)
 
@@ -172,25 +168,26 @@ def make_pp_lm_train_step(
 
             (_, ys), _ = lax.scan(tick, (state0, ys0),
                                   jnp.arange(M + S - 1))
-            logits = head(outer, ys.reshape(b, t, -1))
+            logits = head(outer, ys.reshape(b, tl, -1))
             per = masked_cross_entropy(logits, y, mask, impl="xla")
             return last * jnp.sum(per) / jnp.maximum(total, 1.0)
 
         local_loss, grads = jax.value_and_grad(loss_fn)(pp_params)
-        loss = lax.psum(local_loss, ("dp", "pp"))
-        # local_loss divides by the GLOBAL token count, so grads are per-device
-        # contributions: outer grads live only on their owning stage (embed
-        # on 0, head on S-1) — sum over 'pp' replicates them; block grads
-        # stay stage-local (their [L/S] shard IS the full grad) and only
-        # sum over 'dp'.
+        loss = lax.psum(local_loss, axes)
+        # local_loss divides by the GLOBAL token count, so grads are
+        # per-device contributions: outer grads live only on their owning
+        # stage (embed on 0, head on S-1) — sum over every axis replicates;
+        # block grads stay stage-local (their [L/S] shard IS the full grad
+        # for those layers) and sum over the data(+sequence) axes only.
         return loss, {
-            "outer": lax.psum(grads["outer"], ("dp", "pp")),
-            "blocks": lax.psum(grads["blocks"], "dp"),
+            "outer": lax.psum(grads["outer"], axes),
+            "blocks": lax.psum(grads["blocks"], block_axes),
         }
 
+    data_spec = P("dp", sp_axis) if sp_axis else P("dp")
     grad_shard = shard_map(
         grad_fn, mesh=mesh,
-        in_specs=(PP_PARAM_SPECS, P("dp"), P("dp"), P("dp")),
+        in_specs=(PP_PARAM_SPECS, data_spec, data_spec, data_spec),
         out_specs=(P(), PP_PARAM_SPECS),
         check_vma=False,
     )
@@ -202,3 +199,52 @@ def make_pp_lm_train_step(
         return optax.apply_updates(pp_params, updates), new_opt, loss
 
     return step
+
+
+def make_pp_lm_train_step(
+    module, tx, mesh: Mesh, *, n_micro: Optional[int] = None,
+    attn_impl: str = "auto",
+) -> Callable:
+    """Build a jitted GPipe train step over a ('dp', 'pp') mesh.
+
+    ``module`` is a TransformerLM (the sequence stays whole; use
+    :func:`make_pp_sp_lm_train_step` to also shard it), ``tx`` an optax
+    transformation. Returns ``step(pp_params, opt_state, x, y, mask) ->
+    (pp_params, opt_state, loss)``; ``x/y/mask [B, T]`` shard over 'dp',
+    each dp shard is further split into ``n_micro`` microbatches that flow
+    through the stage ring. ``module.layers`` must divide evenly into
+    ``mesh.shape['pp']`` stages.
+    """
+    return _make_pp_step(module, tx, mesh, n_micro, attn_impl,
+                         sp_axis=None, sp_mode="ring")
+
+
+def pp3d_mesh(n_dp: int, n_pp: int, n_sp: int) -> Mesh:
+    """('dp', 'pp', 'sp') mesh: batch x pipeline stages x sequence."""
+    devs = jax.devices()
+    need = n_dp * n_pp * n_sp
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:need]).reshape(n_dp, n_pp, n_sp),
+                ("dp", "pp", "sp"))
+
+
+def make_pp_sp_lm_train_step(
+    module, tx, mesh: Mesh, *, n_micro: Optional[int] = None,
+    attn_impl: str = "auto", sp_mode: str = "ring",
+) -> Callable:
+    """GPipe pipeline with sequence-parallel attention INSIDE each stage —
+    DeepSpeed-style 3-D (dp, pp, sp) parallelism as ONE jitted program.
+
+    The stacked blocks shard over 'pp' exactly as in
+    :func:`make_pp_lm_train_step`; additionally every activation tile is
+    sequence-sharded over 'sp', and each Block runs ring (or Ulysses)
+    attention whose K/V hop the 'sp' axis while microbatches hop the 'pp'
+    axis — both collectives ride ICI neighbours inside the same lax.scan.
+    Exact vs the single-device step (tested on a (2,2,2) CPU mesh).
+
+    ``x/y/mask [B, T]`` shard as P('dp', 'sp'); ``module`` is a plain
+    TransformerLM config (its ring fields are overridden here).
+    """
+    return _make_pp_step(module, tx, mesh, n_micro, attn_impl,
+                         sp_axis="sp", sp_mode=sp_mode)
