@@ -22,6 +22,13 @@ inside ONE jitted program over a ('dp', 'pp') mesh:
 Exactness: with the same params/batch, loss and the updated params equal
 the single-device step to float tolerance (tested in
 tests/test_pipeline.py) — the pipeline only reorders compute.
+
+:func:`make_pp_sp_lm_train_step` extends the same schedule to a 3-D
+('dp', 'pp', 'sp') mesh: activations are additionally sequence-sharded
+and each stage's blocks run ring/Ulysses attention over 'sp', so K/V hop
+the sequence ring while microbatches hop the stage ring — both inside one
+program. Both step builders share one implementation (:func:`_make_pp_step`;
+the 2-D step is the n_sp=1 case).
 """
 
 from __future__ import annotations
