@@ -141,3 +141,18 @@ def test_every_algorithm_has_a_main_alias():
     for m in sorted(mains):
         mod = importlib.import_module(f"fedml_tpu.experiments.main_{m}")
         assert hasattr(mod, "main")
+
+
+def test_bench_tiny_smoke(monkeypatch, capsys):
+    """bench.py is the driver's per-round artifact — its tiny CPU smoke must
+    emit one JSON line with the contract keys (metric/value/unit/vs_baseline)."""
+    import bench
+
+    monkeypatch.setenv("BENCH_SCALE", "tiny")
+    monkeypatch.setenv("BENCH_MODEL", "lr")
+    monkeypatch.setenv("BENCH_NO_CACHE", "1")
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(out)
+    assert out["value"] > 0
