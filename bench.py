@@ -29,6 +29,53 @@ import numpy as np
 
 BASELINE_IMG_PER_SEC = 12000.0  # 8xV100 estimate, see module docstring
 
+# bf16 peak FLOP/s by TPU generation (public spec sheets), for the MFU line.
+_PEAK_BF16 = (
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v6", 918e12), ("v4", 275e12),
+)
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for frag, peak in _PEAK_BF16:
+        if frag in kind:
+            return peak
+    return None
+
+
+def _fwd_flops_per_image(bundle, variables, input_shape, batch, dtype):
+    """Forward-pass FLOPs per image from XLA's own cost model (compile the
+    eval forward, read cost_analysis). Falls back to the CPU backend when
+    the accelerator's compiled executable doesn't expose an analysis (the
+    remote-compile tunnel), and to None if both fail."""
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(v, x):
+        return bundle.apply_eval(v, x)
+
+    x = jnp.zeros((batch,) + tuple(input_shape), dtype)
+    for backend in (None, "cpu"):
+        try:
+            if backend is None:
+                c = jax.jit(fwd).lower(variables, x).compile()
+            else:
+                dev = jax.local_devices(backend=backend)[0]
+                c = (jax.jit(fwd)
+                     .trace(jax.device_put(variables, dev), jax.device_put(x, dev))
+                     .lower(lowering_platforms=(backend,)).compile())
+            ca = c.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            flops = float(ca.get("flops", 0.0))
+            if flops > 0:
+                return flops / batch
+        except Exception:
+            continue
+    return None
+
 # Bench config (north star: 32 non-IID clients, ResNet-56, CIFAR-10 shapes)
 NUM_CLIENTS = 32
 CLIENTS_PER_ROUND = 8
@@ -84,8 +131,9 @@ def main():
     # Warmup pass: run every measured round once so each distinct cohort
     # bucket's XLA program is compiled before the timed pass (run_round(r)
     # samples deterministically from r, so the timed pass reuses the exact
-    # same programs). run_round syncs on the returned loss each call.
-    for r in range(rounds + 1):
+    # same programs — warm exactly the measured rounds 1..N).
+    # run_round syncs on the returned loss each call.
+    for r in range(1, rounds + 1):
         api.run_round(r)
 
     t0 = time.perf_counter()
@@ -106,6 +154,19 @@ def main():
     img_per_sec = real_images / dt
     rounds_per_sec = rounds / dt
 
+    # MFU accounting: fwd FLOPs/image from XLA's cost model, x3 for the
+    # training step (fwd + ~2x bwd). Executed compute = the PADDED rate
+    # (masked padding steps still burn MXU cycles), so
+    # mfu = padded_rate * train_flops_per_image / device bf16 peak — the
+    # honest device-utilization number for the roofline discussion
+    # (VERDICT r1 weak#1; see docs/perf.md).
+    fwd_flops = _fwd_flops_per_image(
+        bundle, api.variables, ds.train_x.shape[2:], batch, jnp.bfloat16)
+    train_flops = fwd_flops * 3.0 if fwd_flops else None
+    peak = _peak_flops(jax.devices()[0])
+    mfu = (round(padded_images / dt * train_flops / peak, 4)
+           if (train_flops and peak) else None)
+
     result = {
         "metric": f"fedavg_local_sgd_images_per_sec ({model}, CIFAR-10 shapes, 32 non-IID clients, 8/round, bf16)",
         "value": round(img_per_sec, 1),
@@ -113,6 +174,8 @@ def main():
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
         "rounds_per_sec": round(rounds_per_sec, 4),
         "padded_images_per_sec": round(padded_images / dt, 1),
+        "model_flops_per_image": round(train_flops) if train_flops else None,
+        "mfu": mfu,
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(result))

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import jax
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
 from fedml_tpu.core.aggregation import agc_clip_update
 from fedml_tpu.core.pytree import tree_weighted_mean
 from fedml_tpu.parallel.local import LocalResult
@@ -28,3 +28,23 @@ class FedAGCAPI(FedAvgAPI):
         stacked = dict(stacked_vars)
         stacked["params"] = clipped_params
         return tree_weighted_mean(stacked, counts), server_state
+
+
+class CrossSiloFedAGCAPI(CrossSiloFedAvgAPI, FedAGCAPI):
+    """FedAGC on the cross-silo mesh path: the unit-wise AGC clip is a pure
+    per-client transform of the locally-trained weights, so it runs on each
+    device BEFORE the weighted psum — no server rank needed at all (the
+    fork's SiloFedAGC._aggregate, silo_fedagc.py:50-69, does the same math
+    after an MPI gather)."""
+
+    def crosssilo_hooks(self):
+        clipping = self.clipping
+
+        def client_transform(gvars, stacked):
+            out = dict(stacked)
+            out["params"] = jax.vmap(
+                lambda local: agc_clip_update(gvars["params"], local, clipping)
+            )(stacked["params"])
+            return out
+
+        return dict(client_transform=client_transform)

@@ -43,8 +43,6 @@ log = logging.getLogger(__name__)
 class FedAvgAPI:
     """Standalone FedAvg simulator (vmap-over-clients on one chip/mesh)."""
 
-    #: hook for subclasses (FedOpt/FedNova/...) to transform the aggregate
-    server_update: Optional[Callable] = None
     #: subclasses that shard round inputs themselves (cross-silo) opt out
     supports_device_data: bool = True
 
@@ -152,6 +150,17 @@ class FedAvgAPI:
         """State threaded through aggregate() across rounds (FedOpt's server
         optimizer moments, FedNova's momentum buffer, ...). {} = stateless."""
         return {}
+
+    def crosssilo_hooks(self) -> Optional[dict]:
+        """Mesh-path translation of this algorithm's ``aggregate``: a dict of
+        make_crosssilo_round hooks (client_transform / reduce_extras /
+        server_update) or None for the plain weighted psum. Algorithms whose
+        aggregation is more than a weighted mean implement this so their
+        CrossSilo* variant runs in-mesh (the counterpart of the reference's
+        one-Aggregator-subclass-per-algorithm MPI deployments, e.g.
+        FedOptAggregator.py:70-120). Only consulted by the cross-silo
+        paradigm's build_round_step."""
+        return None
 
     def aggregate(self, variables, stacked_vars, counts, infos: LocalResult, rng, server_state):
         """Weighted average (fedavg_api.py:100-115). Subclasses change this.
@@ -388,11 +397,25 @@ class FedAvgAPI:
                 if step is None:
                     # bound the compile cache: with failure injection the
                     # live mask varies the group tuple round to round and
-                    # the key space is large — evict oldest-compiled first
+                    # the key space is large — evict least-recently-USED
+                    # (dict order = recency, maintained below)
                     if len(self._group_steps) >= 64:
                         self._group_steps.pop(next(iter(self._group_steps)))
+                        n_evict = self.history.get("group_step_evictions", 0) + 1
+                        self.history["group_step_evictions"] = n_evict
+                        # visible counter: every eviction implies a fresh XLA
+                        # compile next time that group tuple recurs — a
+                        # pathological config (high failure_prob + many
+                        # groups) shows up here instead of as mystery slowness
+                        log.info("group-step cache full: evicted 1 of 64 "
+                                 "compiled round programs (total evictions %d)",
+                                 n_evict)
                     step = self._group_steps[groups] = \
                         self.build_round_step_gather_groups(groups)
+                else:
+                    # LRU touch: re-insert so steady-state hot group tuples
+                    # stay resident under eviction pressure
+                    self._group_steps[groups] = self._group_steps.pop(groups)
                 self.variables, self.server_state, train_loss = step(
                     self.variables, self.server_state, *self._dev_train,
                     jnp.asarray(sampled[perm], jnp.int32),
@@ -516,11 +539,11 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
     handles_own_device_data = True  # _maybe_place_sharded honors the flag
     elastic_rounds_ok = True      # the psum path guards zero total weight
 
-    def __init__(self, dataset, config, bundle=None, mesh=None):
+    def __init__(self, dataset, config, bundle=None, mesh=None, **kw):
         from fedml_tpu.parallel.mesh import client_mesh
 
         self.mesh = mesh or client_mesh()
-        super().__init__(dataset, config, bundle)
+        super().__init__(dataset, config, bundle, **kw)
         axis_sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         if "clients" not in axis_sizes:
             raise ValueError(f"mesh must have a 'clients' axis, got {self.mesh.axis_names}")
@@ -580,24 +603,27 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
 
     def build_round_step(self):
         from fedml_tpu.parallel.crosssilo import make_crosssilo_round, place_round_inputs
+        from fedml_tpu.parallel.mesh import replicated
 
-        if type(self).aggregate is not FedAvgAPI.aggregate:
-            raise NotImplementedError(
-                f"{type(self).__name__} overrides aggregate(), which the in-mesh "
-                "psum path cannot honor; override build_round_step too, or pass a "
-                "server_update hook (applied after the psum), or use the "
-                "simulation paradigm (FedAvgAPI)."
-            )
-        round_fn = make_crosssilo_round(
-            self._local_train, self.mesh, server_update=self.server_update
-        )
+        hooks = self.crosssilo_hooks()
+        if hooks is None:
+            if type(self).aggregate is not FedAvgAPI.aggregate:
+                raise NotImplementedError(
+                    f"{type(self).__name__} overrides aggregate(), which the in-mesh "
+                    "psum path cannot honor; implement crosssilo_hooks() (see "
+                    "make_crosssilo_round), override build_round_step, or use the "
+                    "simulation paradigm (FedAvgAPI)."
+                )
+            hooks = {}
+        round_fn = make_crosssilo_round(self._local_train, self.mesh, **hooks)
 
         def round_step(variables, server_state, cx, cy, cm, counts, rng):
             keys = jax.random.split(rng, cx.shape[0])
             variables, cx, cy, cm, counts, keys = place_round_inputs(
                 self.mesh, variables, cx, cy, cm, counts, keys
             )
-            new_vars, loss = round_fn(variables, cx, cy, cm, counts, keys)
-            return new_vars, server_state, loss
+            server_state = jax.device_put(server_state, replicated(self.mesh))
+            return round_fn(variables, server_state, cx, cy, cm, counts, keys,
+                            jax.device_put(rng, replicated(self.mesh)))
 
         return round_step

@@ -23,18 +23,23 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
 from fedml_tpu.parallel.local import LocalResult
+
+
+def _nova_a(tau: jax.Array, rho: float) -> jax.Array:
+    """FedNova's per-client normalizing coefficient a_i from step count tau_i
+    (closed form of the reference optimizer's accumulation, fednova.py:10-155)."""
+    if rho > 0.0:
+        return (tau - rho * (1.0 - jnp.power(rho, tau)) / (1.0 - rho)) / (1.0 - rho)
+    return tau
 
 
 class FedNovaAPI(FedAvgAPI):
     def aggregate(self, variables, stacked_vars, counts, infos: LocalResult, rng, server_state):
         rho = float(self.config.momentum)
         tau = infos.tau.astype(jnp.float32)  # [C]
-        if rho > 0.0:
-            a = (tau - rho * (1.0 - jnp.power(rho, tau)) / (1.0 - rho)) / (1.0 - rho)
-        else:
-            a = tau
+        a = _nova_a(tau, rho)
         p = counts.astype(jnp.float32)
         p = p / jnp.maximum(jnp.sum(p), 1e-12)
         tau_eff = jnp.sum(p * a)
@@ -58,3 +63,45 @@ class FedNovaAPI(FedAvgAPI):
         new_vars = dict(new_vars)
         new_vars["params"] = new_params
         return new_vars, server_state
+
+
+class CrossSiloFedNovaAPI(CrossSiloFedAvgAPI, FedNovaAPI):
+    """FedNova on the cross-silo mesh path. The normalized-update math
+    decomposes into weighted partial sums that ride the same all-reduce as
+    the parameters:
+
+        pd = sum_i (n_i / a_i) (w_global - w_i)     (leafwise, psum'd)
+        na = sum_i  n_i * a_i                       (scalar,   psum'd)
+        w_next = w_global - na * pd / n_total^2
+
+    which equals the simulation form  w - tau_eff * sum_i p_i d_i  with
+    tau_eff = na/n_total and p_i = n_i/n_total — the reference runs this
+    as a rank-0 aggregation over MPI-gathered state dicts
+    (standalone/fednova/fednova_trainer.py:97-124); here it is one psum."""
+
+    def crosssilo_hooks(self):
+        rho = float(self.config.momentum)
+
+        def reduce_extras(gvars, res, w):
+            a = _nova_a(res.tau.astype(jnp.float32), rho)
+            inv = w / jnp.maximum(a, 1e-12)  # n_i / a_i  [local clients]
+
+            def pd_leaf(g, s):
+                cb = inv.reshape((-1,) + (1,) * (s.ndim - 1))
+                return jnp.sum((g[None].astype(jnp.float32)
+                                - s.astype(jnp.float32)) * cb, axis=0)
+
+            pd = jax.tree.map(pd_leaf, gvars["params"], res.variables["params"])
+            return {"pd": pd, "na": jnp.sum(w * a)}
+
+        def server_update(vars0, agg, extras, total, server_state, rng):
+            den2 = jnp.square(jnp.maximum(total, 1e-12))
+
+            def combine(g, d):
+                return (g.astype(jnp.float32) - extras["na"] * d / den2).astype(g.dtype)
+
+            new_vars = dict(agg)  # non-param collections: weighted average
+            new_vars["params"] = jax.tree.map(combine, vars0["params"], extras["pd"])
+            return new_vars, server_state
+
+        return dict(reduce_extras=reduce_extras, server_update=server_update)

@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import optax
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
 from fedml_tpu.core.pytree import tree_sub, tree_weighted_mean
 from fedml_tpu.parallel.local import LocalResult
 
@@ -41,11 +41,11 @@ def make_server_optimizer(name: str, lr: float, momentum: float = 0.0) -> optax.
 class FedOptAPI(FedAvgAPI):
     """FedAvg with a persistent server optimizer over the pseudo-gradient."""
 
-    def __init__(self, dataset, config, bundle=None):
+    def __init__(self, dataset, config, bundle=None, **kw):
         self._server_tx = make_server_optimizer(
             config.server_optimizer, config.server_lr, config.server_momentum
         )
-        super().__init__(dataset, config, bundle)
+        super().__init__(dataset, config, bundle, **kw)
 
     def init_server_state(self):
         return {"opt": self._server_tx.init(self.variables["params"])}
@@ -63,3 +63,27 @@ class FedOptAPI(FedAvgAPI):
         new_vars = dict(avg)  # non-param collections (batch_stats) take the average
         new_vars["params"] = new_params
         return new_vars, {"opt": opt_state}
+
+
+class CrossSiloFedOptAPI(CrossSiloFedAvgAPI, FedOptAPI):
+    """FedOpt on the cross-silo mesh path: the weighted psum produces the
+    client average on every device, then the server optimizer step runs
+    replicated post-collective — the in-mesh counterpart of the reference's
+    rank-0 FedOptAggregator (distributed/fedopt/FedOptAggregator.py:70-120),
+    with no server rank and the optimizer state threaded through the one
+    jitted round program."""
+
+    def crosssilo_hooks(self):
+        tx = self._server_tx
+
+        def server_update(vars0, agg, extras, total, server_state, rng):
+            pseudo_grad = tree_sub(vars0["params"], agg["params"])
+            updates, opt_state = tx.update(
+                pseudo_grad, server_state["opt"], vars0["params"]
+            )
+            new_params = optax.apply_updates(vars0["params"], updates)
+            new_vars = dict(agg)
+            new_vars["params"] = new_params
+            return new_vars, {"opt": opt_state}
+
+        return dict(server_update=server_update)

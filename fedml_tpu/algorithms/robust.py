@@ -20,8 +20,12 @@ from typing import Optional
 import jax
 import numpy as np
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI
-from fedml_tpu.core.aggregation import robust_aggregate
+from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
+from fedml_tpu.core.aggregation import (
+    add_dp_noise,
+    clip_update_by_norm,
+    robust_aggregate,
+)
 from fedml_tpu.parallel.local import LocalResult, finalize_metrics
 
 
@@ -71,6 +75,29 @@ class FedAvgRobustAPI(FedAvgAPI):
         )
         return agg, server_state
 
+    def crosssilo_hooks(self):
+        """Mesh-path split of robust_aggregate: the norm-difference clip is
+        per-client (pre-psum, on each silo's device); the weak-DP gaussian
+        noise is added to the replicated aggregate post-psum with the SAME
+        round key on every device, so the result is identical to the
+        reference's rank-0 defense (FedAvgRobustAggregator.py:14-60)."""
+        c = self.config
+        norm_bound, stddev = c.norm_bound, c.stddev
+
+        def client_transform(gvars, stacked):
+            if norm_bound is None:
+                return stacked
+            return jax.vmap(
+                lambda local: clip_update_by_norm(gvars, local, norm_bound)
+            )(stacked)
+
+        def server_update(vars0, agg, extras, total, server_state, rng):
+            if stddev is not None:
+                agg = add_dp_noise(agg, stddev, rng)
+            return agg, server_state
+
+        return dict(client_transform=client_transform, server_update=server_update)
+
     def evaluate_backdoor(self) -> dict:
         """Targeted-class success on triggered test inputs (reference
         FedAvgRobustAggregator's backdoor eval on the targeted task)."""
@@ -83,3 +110,10 @@ class FedAvgRobustAPI(FedAvgAPI):
         sums = self._eval(self.variables, x, y, m)
         out = finalize_metrics(jax.tree.map(np.asarray, sums))
         return {"backdoor_success": out.get("acc", 0.0)}
+
+
+class CrossSiloFedAvgRobustAPI(CrossSiloFedAvgAPI, FedAvgRobustAPI):
+    """FedAvg-robust on the cross-silo mesh path: clip per-silo pre-psum,
+    DP-noise the replicated aggregate post-psum (hooks defined on
+    FedAvgRobustAPI.crosssilo_hooks). The attacker is just one of the
+    sharded silos; the backdoor eval is unchanged."""

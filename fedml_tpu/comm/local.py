@@ -68,19 +68,33 @@ class LocalCommunicationManager(BaseCommunicationManager):
         self.router.post(self.rank, _STOP)
 
 
-def run_ranks(make_manager, size: int, wire_roundtrip: bool = False, timeout: float = 300.0):
+def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
+              timeout: float = 300.0, comm_factory=None):
     """Launch ``size`` ranks on threads; rank r runs make_manager(r, comm).
 
     ``make_manager`` returns an object with ``.run()`` (typically a
     ClientManager/ServerManager subclass). Returns the per-rank manager
     objects after all threads join. Mirrors the reference's
     ``mpirun -np N`` + rank branch (FedAvgAPI.py:20-28) for in-process use.
+
+    ``comm_factory(rank) -> BaseCommunicationManager`` substitutes a real
+    transport (e.g. gRPC loopback) for the in-process router; the default
+    builds LocalCommunicationManagers over one shared LocalRouter.
     """
-    router = LocalRouter(size)
-    managers = []
-    for r in range(size):
-        comm = LocalCommunicationManager(router, r, wire_roundtrip=wire_roundtrip)
-        managers.append(make_manager(r, comm))
+    router = None if comm_factory else LocalRouter(size)
+    comms: list[BaseCommunicationManager] = []
+    try:
+        for r in range(size):
+            comms.append(
+                comm_factory(r) if comm_factory
+                else LocalCommunicationManager(router, r, wire_roundtrip=wire_roundtrip))
+        managers = [make_manager(r, comms[r]) for r in range(size)]
+    except BaseException:
+        # partial setup (e.g. a gRPC port already bound): release what was
+        # created so a retry in-process doesn't inherit bound ports
+        for c in comms:
+            c.stop_receive_message()
+        raise
 
     errors: Dict[int, BaseException] = {}
 
@@ -89,8 +103,8 @@ def run_ranks(make_manager, size: int, wire_roundtrip: bool = False, timeout: fl
             m.run()
         except BaseException as e:  # propagate to the caller, unblock peers
             errors[rank] = e
-            for peer in range(size):
-                router.post(peer, _STOP)
+            for c in comms:
+                c.stop_receive_message()
 
     threads = [
         threading.Thread(target=_run, args=(r, m), daemon=True, name=f"rank{r}")

@@ -1,0 +1,323 @@
+"""Message-driven TurboAggregate — the secure-aggregation protocol over the
+edge transport.
+
+Counterpart of reference fedml_api/distributed/turboaggregate/
+(TA_decentralized_worker_manager.py + TA_fedavg.py): workers hold additive
+shares of each group-mate's masked update, group leaders relay the running
+field total along the group ring, and only the final unmasked total reaches
+the server. The reference runs this over MPI with torch state dicts; here the
+same group-relay topology runs over the framework's Message transports
+(comm/local.py threads, or gRPC via ``comm_factory``), and the field math is
+the vectorized int64 MPC kernel shared with the host-simulated form
+(algorithms/turboaggregate.py) — so the recovered aggregate is BIT-EQUAL to
+``secure_weighted_sum`` on the same inputs (additive masks cancel exactly in
+the prime field, whatever RNG drew them).
+
+Per round, with C clients in G = max(1, C // group_size) round-robin groups
+(group g = clients {g, g+G, ...}, matching secure_weighted_sum's grouping):
+
+  server --SYNC(model, weight)-->  every client
+  client: local-train, q = quantize(flat_update * w), split q into
+          |group| additive shares, one --SHARE--> per group-mate
+  client: sum of received shares  --PARTIAL--> group leader
+  leader: own partials + relay-in --RELAY-->   next group's leader
+  last leader                     --TOTAL-->   server (dequantize, next round)
+
+No hop ever sees a client's update in the clear: shares and partial sums are
+field-uniform until the final total is unmasked at the server.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+from fedml_tpu.algorithms.turboaggregate import (
+    P_DEFAULT,
+    additive_shares,
+    dequantize,
+    quantize,
+)
+from fedml_tpu.comm import ClientManager, Message, ServerManager
+from fedml_tpu.comm.local import run_ranks
+from fedml_tpu.comm.message import MSG_ARG_KEY_MODEL_PARAMS
+from fedml_tpu.core.rng import round_key, seed_everything
+from fedml_tpu.core.tasks import get_task
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel.local import finalize_metrics, make_eval_fn, make_local_train_fn
+
+LOG = logging.getLogger(__name__)
+
+MSG_TYPE_S2C_SYNC = "ta_sync"        # server -> clients: model + round + weight
+MSG_TYPE_C2C_SHARE = "ta_share"      # additive share to a group-mate
+MSG_TYPE_C2L_PARTIAL = "ta_partial"  # masked partial sum to the group leader
+MSG_TYPE_L2L_RELAY = "ta_relay"      # running field total along the group ring
+MSG_TYPE_L2S_TOTAL = "ta_total"      # final field total to the server
+MSG_TYPE_S2C_FINISH = "ta_finish"
+
+KEY_ROUND = "round"
+KEY_WEIGHT = "weight"
+KEY_FIELD = "field"          # int64 field vector payload
+KEY_LOSS_SUM = "loss_sum"    # non-secret metric riding the relay
+KEY_COUNT_SUM = "count_sum"
+
+
+def _groups(num_clients: int, group_size: int) -> list[list[int]]:
+    """Round-robin grouping, identical to secure_weighted_sum's
+    ``range(g, C, n_groups)`` (algorithms/turboaggregate.py:232)."""
+    n_groups = max(1, num_clients // group_size)
+    return [list(range(g, num_clients, n_groups)) for g in range(n_groups)]
+
+
+class TAEdgeServerManager(ServerManager):
+    """Round driver + unmasker (reference TA_fedavg aggregator role): sends
+    the model out, receives ONE field total per round, dequantizes."""
+
+    def __init__(self, args, comm, rank, size, variables, dataset, bundle,
+                 frac_bits: int, p=P_DEFAULT):
+        super().__init__(args, comm, rank, size)
+        self.variables = variables
+        self.dataset = dataset
+        self.frac_bits = frac_bits
+        self.p = p
+        self.round_idx = 0
+        self.round_num = int(args.comm_round)
+        self.history: dict[str, list] = {"round": [], "Test/Acc": [],
+                                         "Test/Loss": [], "Train/Loss": []}
+        self._eval = make_eval_fn(bundle, get_task(dataset.task, dataset.class_num))
+        # flatten template: leaf order/shape/dtype for field <-> pytree
+        leaves, self._treedef = jax.tree.flatten(jax.tree.map(np.asarray, variables))
+        self._shapes = [l.shape for l in leaves]
+        self._dtypes = [l.dtype for l in leaves]
+        counts = np.asarray(dataset.train_counts, np.float64)[: size - 1]
+        self._weights = counts / counts.sum()
+        self._counts = counts
+
+    def run(self):
+        self.register_message_receive_handlers()
+        self._send_sync()
+        self.com_manager.handle_receive_message()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_L2S_TOTAL, self._on_total)
+
+    def _send_sync(self):
+        for rank in range(1, self.size):
+            m = Message(MSG_TYPE_S2C_SYNC, self.rank, rank)
+            m.add_params(MSG_ARG_KEY_MODEL_PARAMS, self.variables)
+            m.add_params(KEY_ROUND, self.round_idx)
+            m.add_params(KEY_WEIGHT, float(self._weights[rank - 1]))
+            self.send_message(m)
+
+    def _on_total(self, msg: Message):
+        assert int(msg.get(KEY_ROUND)) == self.round_idx
+        field_total = np.asarray(msg.get(KEY_FIELD), np.int64)
+        flat = dequantize(field_total, self.frac_bits, self.p)
+        out, off = [], 0
+        for shape, dtype in zip(self._shapes, self._dtypes):
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            out.append(flat[off:off + n].reshape(shape).astype(dtype))
+            off += n
+        self.variables = jax.tree.unflatten(self._treedef, out)
+        train_loss = float(msg.get(KEY_LOSS_SUM)) / max(float(msg.get(KEY_COUNT_SUM)), 1e-12)
+        if (self.round_idx % self.args.frequency_of_the_test == 0
+                or self.round_idx == self.round_num - 1):
+            sums = self._eval(self.variables, self.dataset.test_x,
+                              self.dataset.test_y, self.dataset.test_mask)
+            m = finalize_metrics(jax.tree.map(np.asarray, sums))
+            self.history["round"].append(self.round_idx)
+            self.history["Test/Acc"].append(m.get("acc"))
+            self.history["Test/Loss"].append(m.get("loss"))
+            self.history["Train/Loss"].append(train_loss)
+        self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            for rank in range(1, self.size):
+                self.send_message(Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
+            self.finish()
+            return
+        self._send_sync()
+
+
+class TAEdgeClientManager(ClientManager):
+    """Worker: local training + the share/partial/relay legs (reference
+    TA_decentralized_worker_manager.py roles, one rank per client)."""
+
+    def __init__(self, args, comm, rank, size, dataset, bundle, config,
+                 root_key, group_size: int, frac_bits: int, p=P_DEFAULT):
+        super().__init__(args, comm, rank, size)
+        self.dataset = dataset
+        self.config = config
+        self.root_key = root_key
+        self.frac_bits = frac_bits
+        self.p = p
+        self.client_idx = rank - 1
+        C = size - 1
+        self.num_clients = C
+        groups = _groups(C, group_size)
+        self._groups_list = groups
+        self.gid = self.client_idx % len(groups)
+        self.members = groups[self.gid]
+        self.my_slot = self.members.index(self.client_idx)
+        self.leader = self.members[0]
+        self.n_groups = len(groups)
+        self.is_leader = self.client_idx == self.leader
+        self.last_group = self.gid == self.n_groups - 1
+        self._rng = np.random.default_rng([config.seed, 0x7A, self.client_idx])
+        self.round_idx = -1
+        # a fast group-mate may deliver round-r+1 legs before OUR SYNC(r+1)
+        # lands (the server's per-rank sends race with peers' sends); such
+        # messages are buffered and replayed right after the SYNC
+        self._ahead: list[tuple] = []
+        self.local_train = jax.jit(make_local_train_fn(
+            bundle, get_task(dataset.task, dataset.class_num),
+            optimizer=config.client_optimizer, lr=config.lr,
+            momentum=config.momentum, wd=config.wd,
+            epochs=config.epochs, batch_size=config.batch_size,
+            grad_clip=config.grad_clip,
+        ))
+        self._reset_round()
+
+    def _reset_round(self):
+        self._share_sum = None
+        self._n_shares = 0
+        self._partial_sum = None
+        self._n_partials = 0
+        self._relay_in = None
+        self._loss_sum = 0.0
+        self._count_sum = 0.0
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_S2C_SYNC, self._on_sync)
+        self.register_message_receive_handler(MSG_TYPE_C2C_SHARE, self._on_share)
+        self.register_message_receive_handler(MSG_TYPE_C2L_PARTIAL, self._on_partial)
+        self.register_message_receive_handler(MSG_TYPE_L2L_RELAY, self._on_relay)
+        self.register_message_receive_handler(MSG_TYPE_S2C_FINISH,
+                                              lambda m: self.finish())
+
+    # -- protocol legs -----------------------------------------------------
+
+    def _ahead_of_round(self, msg: Message, handler) -> bool:
+        r = int(msg.get(KEY_ROUND))
+        if r == self.round_idx:
+            return False
+        if r < self.round_idx:  # relay gating makes past rounds impossible
+            raise RuntimeError(
+                f"client {self.client_idx}: stale round {r} message "
+                f"(at round {self.round_idx}): {msg}")
+        self._ahead.append((handler, msg))
+        return True
+
+    def _on_sync(self, msg: Message):
+        self._reset_round()
+        self.round_idx = int(msg.get(KEY_ROUND))
+        if self.gid == 0 and self.is_leader:
+            self._relay_in = np.zeros(1, np.int64)  # ring head starts at 0
+        variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        w = float(msg.get(KEY_WEIGHT))
+        x, y, m, count = self.dataset.client_slice(np.asarray([self.client_idx]))
+        rng = jax.random.split(round_key(self.root_key, self.round_idx),
+                               self.num_clients)[self.client_idx]
+        res = self.local_train(variables, x[0], y[0], m[0],
+                               np.float32(count[0]), rng)
+        self._loss_own = float(res.train_loss) * float(count[0])
+        self._count_own = float(count[0])
+        leaves = jax.tree.leaves(jax.tree.map(np.asarray, res.variables))
+        flat = np.concatenate([np.ravel(l).astype(np.float64) for l in leaves])
+        q = quantize(flat * w, self.frac_bits, self.p)
+        shares = additive_shares(q, len(self.members), self.p, self._rng)
+        for slot, member in enumerate(self.members):
+            m_out = Message(MSG_TYPE_C2C_SHARE, self.rank, member + 1)
+            m_out.add_params(KEY_ROUND, self.round_idx)
+            m_out.add_params(KEY_FIELD, shares[slot])
+            self.send_message(m_out)
+        for handler, pending in self._ahead:
+            handler(pending)
+        self._ahead.clear()
+
+    def _on_share(self, msg: Message):
+        if self._ahead_of_round(msg, self._on_share):
+            return
+        share = np.asarray(msg.get(KEY_FIELD), np.int64)
+        self._share_sum = (share if self._share_sum is None
+                           else np.mod(self._share_sum + share, self.p))
+        self._n_shares += 1
+        if self._n_shares == len(self.members):
+            out = Message(MSG_TYPE_C2L_PARTIAL, self.rank, self.leader + 1)
+            out.add_params(KEY_ROUND, self.round_idx)
+            out.add_params(KEY_FIELD, self._share_sum)
+            out.add_params(KEY_LOSS_SUM, self._loss_own)
+            out.add_params(KEY_COUNT_SUM, self._count_own)
+            self.send_message(out)
+
+    def _on_partial(self, msg: Message):
+        assert self.is_leader
+        if self._ahead_of_round(msg, self._on_partial):
+            return
+        part = np.asarray(msg.get(KEY_FIELD), np.int64)
+        self._partial_sum = (part if self._partial_sum is None
+                             else np.mod(self._partial_sum + part, self.p))
+        self._n_partials += 1
+        self._loss_sum += float(msg.get(KEY_LOSS_SUM))
+        self._count_sum += float(msg.get(KEY_COUNT_SUM))
+        self._maybe_relay()
+
+    def _on_relay(self, msg: Message):
+        assert self.is_leader
+        if self._ahead_of_round(msg, self._on_relay):
+            return
+        self._relay_in = np.asarray(msg.get(KEY_FIELD), np.int64)
+        self._loss_sum += float(msg.get(KEY_LOSS_SUM))
+        self._count_sum += float(msg.get(KEY_COUNT_SUM))
+        self._maybe_relay()
+
+    def _maybe_relay(self):
+        if self._relay_in is None or self._n_partials != len(self.members):
+            return
+        total = np.mod(self._relay_in + self._partial_sum, self.p)
+        if self.last_group:
+            out = Message(MSG_TYPE_L2S_TOTAL, self.rank, 0)
+        else:
+            next_leader = self._groups_list[self.gid + 1][0]
+            out = Message(MSG_TYPE_L2L_RELAY, self.rank, next_leader + 1)
+        out.add_params(KEY_ROUND, self.round_idx)
+        out.add_params(KEY_FIELD, total)
+        out.add_params(KEY_LOSS_SUM, self._loss_sum)
+        out.add_params(KEY_COUNT_SUM, self._count_sum)
+        self.send_message(out)
+
+
+def run_turboaggregate_edge(dataset, config, group_size: int = 2,
+                            frac_bits: int = 20, wire_roundtrip: bool = True,
+                            comm_factory=None):
+    """Launch 1 server + num_clients workers over the local transport (or a
+    real one via ``comm_factory``) and run the full secure-relay federation.
+    Returns the server manager (final ``variables`` + ``history``)."""
+    C = min(config.client_num_in_total, dataset.num_clients)
+    bundle = create_model(config.model, dataset.class_num,
+                          input_shape=dataset.train_x.shape[2:] or None)
+    root_key = seed_everything(config.seed)
+    variables0 = jax.tree.map(np.asarray, bundle.init(root_key))
+    size = C + 1
+
+    class Args:
+        pass
+
+    args = Args()
+    args.comm_round = config.comm_round
+    args.frequency_of_the_test = config.frequency_of_the_test
+
+    holder = {}
+
+    def make(rank, comm):
+        if rank == 0:
+            holder["server"] = TAEdgeServerManager(
+                args, comm, rank, size, variables0, dataset, bundle, frac_bits)
+            return holder["server"]
+        return TAEdgeClientManager(args, comm, rank, size, dataset, bundle,
+                                   config, root_key, group_size, frac_bits)
+
+    run_ranks(make, size, wire_roundtrip=wire_roundtrip,
+              comm_factory=comm_factory)
+    return holder["server"]

@@ -1,0 +1,229 @@
+"""Message-driven vertical FL — the guest/host exchange over the edge
+transport.
+
+Counterpart of reference fedml_api/distributed/classical_vertical_fl/
+(vfl_api.py:16-42 + guest_manager.py/host_manager.py): one process per party
+over MPI, per batch the hosts send logit components, the guest returns the
+common gradient. Here the SAME party objects as the host-simulated protocol
+(algorithms/vfl.py VFLGuestParty/VFLHostParty — the executable spec) run
+inside ClientManager/ServerManager runtimes over the framework transports
+(comm/local.py threads, or gRPC via ``comm_factory``).
+
+Privacy surface matches the reference: raw features never leave a party —
+only row indices, [B,1] logit components, and the [B,1] common gradient
+travel. Batch order is driven by the guest exactly like VFLAPI.fit
+(epoch-wise permutation from numpy default_rng(seed)), so the wire run is
+BYTE-EQUAL to the in-process protocol run on the same seed: the party
+compute is the same jitted functions on the same inputs in the same order,
+and the wire format round-trips arrays exactly.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.vfl import (
+    VFLGuestParty,
+    VFLHostParty,
+    bce_with_logits,
+    init_party_params,
+    party_component,
+)
+from fedml_tpu.comm import ClientManager, Message, ServerManager
+from fedml_tpu.comm.local import run_ranks
+
+LOG = logging.getLogger(__name__)
+
+MSG_TYPE_G2H_BATCH = "vfl_batch"       # guest -> host: row indices
+MSG_TYPE_H2G_COMPONENT = "vfl_comp"    # host -> guest: logit component
+MSG_TYPE_G2H_GRAD = "vfl_grad"         # guest -> host: common gradient
+MSG_TYPE_G2H_EVAL = "vfl_eval"         # guest -> host: test components request
+MSG_TYPE_H2G_EVAL_COMP = "vfl_eval_comp"
+MSG_TYPE_G2H_FINISH = "vfl_finish"
+
+KEY_IDX = "idx"
+KEY_U = "u"
+KEY_STEP = "step"
+
+
+class VFLHostManager(ClientManager):
+    """Host party runtime (reference host_manager.py): holds its feature
+    slice and a VFLHostParty; answers batches with components, learns from
+    the common gradient."""
+
+    def __init__(self, args, comm, rank, size, party: VFLHostParty, x_train, x_test):
+        super().__init__(args, comm, rank, size)
+        self.party = party
+        self.x_train = np.asarray(x_train)
+        self.x_test = np.asarray(x_test)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_G2H_BATCH, self._on_batch)
+        self.register_message_receive_handler(MSG_TYPE_G2H_GRAD, self._on_grad)
+        self.register_message_receive_handler(MSG_TYPE_G2H_EVAL, self._on_eval)
+        self.register_message_receive_handler(MSG_TYPE_G2H_FINISH,
+                                              lambda m: self.finish())
+
+    def _on_batch(self, msg: Message):
+        idx = np.asarray(msg.get(KEY_IDX), np.int64)
+        self.party.set_batch(self.x_train[idx])
+        out = Message(MSG_TYPE_H2G_COMPONENT, self.rank, 0)
+        out.add_params(KEY_STEP, msg.get(KEY_STEP))
+        out.add_params(KEY_U, np.asarray(self.party.send_components()))
+        self.send_message(out)
+
+    def _on_grad(self, msg: Message):
+        self.party.receive_gradients(jnp.asarray(msg.get(KEY_U)))
+
+    def _on_eval(self, msg: Message):
+        out = Message(MSG_TYPE_H2G_EVAL_COMP, self.rank, 0)
+        out.add_params(KEY_U, np.asarray(self.party.predict(self.x_test)))
+        self.send_message(out)
+
+
+class VFLGuestManager(ServerManager):
+    """Guest party runtime + batch driver (reference guest_manager.py +
+    vfl_api.py:16-42): owns the labels, fuses components, broadcasts the
+    common gradient, drives the epoch/batch schedule of VFLAPI.fit."""
+
+    def __init__(self, args, comm, rank, size, party: VFLGuestParty, dataset):
+        super().__init__(args, comm, rank, size)
+        self.party = party
+        self.dataset = dataset
+        n = len(dataset.train_y)
+        self.bs = min(int(args.batch_size), n)
+        self.steps = n // self.bs
+        self.epochs = int(args.epochs)
+        self._order_rng = np.random.default_rng(args.seed)
+        self.epoch = 0
+        self.step = 0
+        self._components: dict[int, np.ndarray] = {}
+        self._eval_components: dict[int, np.ndarray] = {}
+        self.losses: list[float] = []
+        self.history: list[dict] = []
+
+    def run(self):
+        self.register_message_receive_handlers()
+        self._next_epoch_order()
+        self._send_batch()
+        self.com_manager.handle_receive_message()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_H2G_COMPONENT, self._on_component)
+        self.register_message_receive_handler(MSG_TYPE_H2G_EVAL_COMP, self._on_eval_component)
+
+    def _next_epoch_order(self):
+        n = len(self.dataset.train_y)
+        self._order = self._order_rng.permutation(n)[: self.steps * self.bs] \
+            .reshape(self.steps, self.bs)
+        self._epoch_losses: list[float] = []
+
+    def _batch_idx(self):
+        return self._order[self.step]
+
+    def _send_batch(self):
+        idx = self._batch_idx()
+        self.party.set_batch(self.dataset.train_parts[0][idx],
+                             self.dataset.train_y[idx])
+        for rank in range(1, self.size):
+            m = Message(MSG_TYPE_G2H_BATCH, self.rank, rank)
+            m.add_params(KEY_STEP, self.step)
+            m.add_params(KEY_IDX, idx.astype(np.int64))
+            self.send_message(m)
+
+    def _on_component(self, msg: Message):
+        assert int(msg.get(KEY_STEP)) == self.step
+        self._components[msg.get_sender_id()] = np.asarray(msg.get(KEY_U))
+        if len(self._components) < self.size - 1:
+            return
+        # fixed host-rank order => the same float sum as the in-process form
+        self.party.receive_components(
+            [jnp.asarray(self._components[r]) for r in range(1, self.size)])
+        self._components.clear()
+        self.party.fit()
+        self._epoch_losses.append(self.party.loss)
+        common = np.asarray(self.party.send_gradients())
+        for rank in range(1, self.size):
+            m = Message(MSG_TYPE_G2H_GRAD, self.rank, rank)
+            m.add_params(KEY_U, common)
+            self.send_message(m)
+        self.step += 1
+        if self.step < self.steps:
+            self._send_batch()
+            return
+        # epoch done
+        self.losses.append(float(np.mean(self._epoch_losses)))
+        self.epoch += 1
+        self.step = 0
+        if self.epoch < self.epochs:
+            self._next_epoch_order()
+            self._send_batch()
+            return
+        # training done -> distributed eval
+        for rank in range(1, self.size):
+            self.send_message(Message(MSG_TYPE_G2H_EVAL, self.rank, rank))
+
+    def _on_eval_component(self, msg: Message):
+        self._eval_components[msg.get_sender_id()] = np.asarray(msg.get(KEY_U))
+        if len(self._eval_components) < self.size - 1:
+            return
+        d = self.dataset
+        u = party_component(self.party.params, jnp.asarray(d.test_parts[0]))
+        u = np.asarray(u) + sum(self._eval_components[r]
+                                for r in range(1, self.size))
+        pred = (u[:, 0] > 0).astype(np.float32)
+        self.history.append({
+            "Train/Loss": self.losses[-1],
+            "Test/Acc": float((pred == d.test_y).mean()),
+            "Test/Loss": float(bce_with_logits(jnp.asarray(u[:, 0]),
+                                               jnp.asarray(d.test_y))),
+        })
+        for rank in range(1, self.size):
+            self.send_message(Message(MSG_TYPE_G2H_FINISH, self.rank, rank))
+        self.finish()
+
+
+def run_vfl_edge(dataset, hidden_dim: int = 16, lr: float = 0.01,
+                 batch_size: int = 64, epochs: int = 10, seed: int = 0,
+                 wire_roundtrip: bool = True, comm_factory=None):
+    """Launch guest (rank 0) + one host per remaining party over the local
+    transport (or gRPC via ``comm_factory``). Same init derivation as
+    build_protocol_vfl(seed) and same batch schedule as VFLAPI.fit(epochs,
+    seed). Returns the guest manager (parties hold final params;
+    ``history[-1]`` the final metrics)."""
+    root = jax.random.PRNGKey(seed)
+    keys = jax.random.split(root, dataset.num_parties)
+    guest = VFLGuestParty(
+        init_party_params(keys[0], dataset.party_dims[0], hidden_dim, guest=True), lr)
+    hosts = {
+        p: VFLHostParty(
+            init_party_params(keys[p], dataset.party_dims[p], hidden_dim,
+                              guest=False), lr)
+        for p in range(1, dataset.num_parties)
+    }
+    size = dataset.num_parties
+
+    class Args:
+        pass
+
+    args = Args()
+    args.batch_size = batch_size
+    args.epochs = epochs
+    args.seed = seed
+
+    holder = {}
+
+    def make(rank, comm):
+        if rank == 0:
+            holder["guest"] = VFLGuestManager(args, comm, rank, size, guest, dataset)
+            return holder["guest"]
+        return VFLHostManager(args, comm, rank, size, hosts[rank],
+                              dataset.train_parts[rank], dataset.test_parts[rank])
+
+    run_ranks(make, size, wire_roundtrip=wire_roundtrip,
+              comm_factory=comm_factory)
+    return holder["guest"]

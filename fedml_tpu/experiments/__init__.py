@@ -25,6 +25,8 @@ ALGORITHMS = (
     "fedavg_robust", "hierarchical", "decentralized", "turboaggregate",
     "fedgkt", "fednas", "fedseg", "splitnn", "vfl", "centralized",
     "silo_fedavg", "silo_fedopt", "silo_fednova", "silo_fedagc",
+    "crosssilo_fedopt", "crosssilo_fednova", "crosssilo_fedagc",
+    "crosssilo_fedavg_robust",
 )
 
 
@@ -134,20 +136,24 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
 
     from fedml_tpu.algorithms.centralized import CentralizedTrainer
     from fedml_tpu.algorithms.decentralized import DecentralizedFedAPI
-    from fedml_tpu.algorithms.fedagc import FedAGCAPI
+    from fedml_tpu.algorithms.fedagc import CrossSiloFedAGCAPI, FedAGCAPI
     from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
-    from fedml_tpu.algorithms.fednova import FedNovaAPI
-    from fedml_tpu.algorithms.fedopt import FedOptAPI
+    from fedml_tpu.algorithms.fednova import CrossSiloFedNovaAPI, FedNovaAPI
+    from fedml_tpu.algorithms.fedopt import CrossSiloFedOptAPI, FedOptAPI
     from fedml_tpu.algorithms.fedprox import FedProxAPI
     from fedml_tpu.algorithms.fedseg import FedSegAPI
     from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
-    from fedml_tpu.algorithms.robust import FedAvgRobustAPI
+    from fedml_tpu.algorithms.robust import CrossSiloFedAvgRobustAPI, FedAvgRobustAPI
     from fedml_tpu.algorithms.silo import SiloRunner
     from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
 
     simple = {
         "fedavg": FedAvgAPI,
         "crosssilo_fedavg": CrossSiloFedAvgAPI,
+        "crosssilo_fedopt": CrossSiloFedOptAPI,
+        "crosssilo_fednova": CrossSiloFedNovaAPI,
+        "crosssilo_fedagc": CrossSiloFedAGCAPI,
+        "crosssilo_fedavg_robust": CrossSiloFedAvgRobustAPI,
         "fedopt": FedOptAPI,
         "fedprox": FedProxAPI,
         "fednova": FedNovaAPI,

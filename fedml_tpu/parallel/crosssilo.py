@@ -31,22 +31,41 @@ def make_crosssilo_round(
     local_train: Callable,
     mesh: Mesh,
     axis: str = "clients",
+    client_transform: Callable | None = None,
+    reduce_extras: Callable | None = None,
     server_update: Callable | None = None,
 ):
     """Build the jitted cross-silo round function.
 
+    The three hooks are how the whole algorithm zoo runs on the mesh path —
+    the reference deploys each algorithm as its own Aggregator subclass over
+    MPI (FedOptAggregator.py:70-120, FedAvgRobustAggregator.py:14-60); here
+    an algorithm is (per-client transform, extra reductions, post-collective
+    server transform) around the one weighted-psum program:
+
+      client_transform(global_vars, stacked_vars) -> stacked_vars
+        per-device, applied to the locally-trained client variables BEFORE
+        the psum (AGC / norm clipping of updates).
+      reduce_extras(global_vars, res, w) -> pytree of f32 partial SUMS
+        per-device weighted partial sums that ride the same all-reduce as
+        the parameters (FedNova's normalized-update sums); psum'd leafwise.
+      server_update(vars0, agg, extras, total, server_state, rng)
+        -> (new_vars, new_server_state)
+        applied identically on every device AFTER the psum, on replicated
+        values only (FedOpt server optimizer, weak-DP noise). ``extras`` is
+        the psum of reduce_extras (or None), ``total`` the psum'd weight.
+
     Args:
       local_train: per-client function from make_local_train_fn.
       mesh: 1-D mesh with ``axis``.
-      server_update: optional f(old_vars, aggregated_vars) -> new_vars applied
-        identically on every device AFTER the psum (FedOpt etc.).
 
-    Returns round_fn(variables, cx, cy, cm, counts, keys) -> (variables, loss)
-    where cx/cy/cm/counts/keys are stacked over sampled clients (leading axis
-    divisible by mesh size) and variables is replicated.
+    Returns round_fn(variables, server_state, cx, cy, cm, counts, keys, rng)
+    -> (variables, server_state, loss) where cx/cy/cm/counts/keys are stacked
+    over sampled clients (leading axis divisible by mesh size) and variables /
+    server_state / rng are replicated.
     """
 
-    def shard_fn(variables, cx, cy, cm, counts, keys):
+    def shard_fn(variables, server_state, cx, cy, cm, counts, keys, rng):
         variables0 = variables  # replicated original (all-failed fallback)
         # Mark the replicated global weights as device-varying before local
         # training. Without this, JAX's varying-manual-axes autodiff treats
@@ -58,6 +77,9 @@ def make_crosssilo_round(
         res: LocalResult = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
             variables, cx, cy, cm, counts, keys
         )
+        stacked = res.variables
+        if client_transform is not None:
+            stacked = client_transform(variables, stacked)
         w = counts.astype(jnp.float32)
         total = jax.lax.psum(jnp.sum(w), axis)
         denom = jnp.maximum(total, 1e-12)
@@ -67,22 +89,35 @@ def make_crosssilo_round(
             s = jax.lax.psum(jnp.sum(x.astype(jnp.float32) * wb, axis=0), axis)
             return (s / denom).astype(x.dtype)
 
-        agg = jax.tree.map(reduce_leaf, res.variables)
-        # elastic rounds: zero-count clients (failed/dropped, counts*live=0)
-        # contribute nothing; if EVERY client failed the round is a no-op —
-        # keep the old weights instead of averaging toward zero
-        keep = total > 0
-        agg = jax.tree.map(lambda n, o: jnp.where(keep, n, o), agg, variables0)
+        agg = jax.tree.map(reduce_leaf, stacked)
+        extras = None
+        if reduce_extras is not None:
+            extras = jax.tree.map(
+                lambda x: jax.lax.psum(x, axis),
+                reduce_extras(variables, res, w),
+            )
         loss = jax.lax.psum(jnp.sum(res.train_loss * w), axis) / denom
         if server_update is not None:
-            agg = server_update(variables, agg)
-        return agg, loss
+            new_vars, new_state = server_update(
+                variables0, agg, extras, total, server_state, rng
+            )
+        else:
+            new_vars, new_state = agg, server_state
+        # elastic rounds: zero-count clients (failed/dropped, counts*live=0)
+        # contribute nothing; if EVERY client failed the round is a full
+        # no-op — weights AND server state roll back (matching the
+        # simulation paradigm's _finish_round guard), else the server
+        # optimizer would absorb the garbage zero-aggregate pseudo-gradient
+        keep = total > 0
+        new_vars = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_vars, variables0)
+        new_state = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_state, server_state)
+        return new_vars, new_state, loss
 
     mapped = shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(), P()),
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P(), P()),
     )
     return jax.jit(mapped)
 

@@ -88,7 +88,8 @@ def place_pp_params(pp_params, mesh: Mesh):
 
 
 def _make_pp_step(module, tx, mesh: Mesh, n_micro: Optional[int],
-                  attn_impl: str, sp_axis: Optional[str], sp_mode: str):
+                  attn_impl: str, sp_axis: Optional[str], sp_mode: str,
+                  xent_impl: str = "auto"):
     """Shared GPipe schedule builder. With ``sp_axis=None`` this is plain
     (dp, pp); with ``sp_axis='sp'`` every activation tile is additionally
     sequence-sharded and each Block runs ring/Ulysses attention over that
@@ -175,9 +176,22 @@ def _make_pp_step(module, tx, mesh: Mesh, n_micro: Optional[int],
 
             (_, ys), _ = lax.scan(tick, (state0, ys0),
                                   jnp.arange(M + S - 1))
-            logits = head(outer, ys.reshape(b, tl, -1))
-            per = masked_cross_entropy(logits, y, mask, impl="xla")
-            return last * jnp.sum(per) / jnp.maximum(total, 1.0)
+
+            # The LM head ((b,tl,D) x (D,V) matmul + cross-entropy) only
+            # produces signal on the last stage (ys stays zeros elsewhere),
+            # but ``stage`` is dynamic inside shard_map so XLA cannot DCE
+            # it — run it under lax.cond so the other S-1 stages execute
+            # the trivial branch at runtime instead of a junk matmul
+            # (matters at real vocab sizes; the fused pallas xent is
+            # selected by ``xent_impl`` like everywhere else in the stack).
+            def last_stage_loss_sum():
+                logits = head(outer, ys.reshape(b, tl, -1))
+                per = masked_cross_entropy(logits, y, mask, impl=xent_impl)
+                return jnp.sum(per)
+
+            s = lax.cond(stage == S - 1, last_stage_loss_sum,
+                         lambda: jnp.zeros((), jnp.float32))
+            return s / jnp.maximum(total, 1.0)
 
         local_loss, grads = jax.value_and_grad(loss_fn)(pp_params)
         loss = lax.psum(local_loss, axes)
@@ -210,7 +224,7 @@ def _make_pp_step(module, tx, mesh: Mesh, n_micro: Optional[int],
 
 def make_pp_lm_train_step(
     module, tx, mesh: Mesh, *, n_micro: Optional[int] = None,
-    attn_impl: str = "auto",
+    attn_impl: str = "auto", xent_impl: str = "auto",
 ) -> Callable:
     """Build a jitted GPipe train step over a ('dp', 'pp') mesh.
 
@@ -223,7 +237,7 @@ def make_pp_lm_train_step(
     ``mesh.shape['pp']`` stages.
     """
     return _make_pp_step(module, tx, mesh, n_micro, attn_impl,
-                         sp_axis=None, sp_mode="ring")
+                         sp_axis=None, sp_mode="ring", xent_impl=xent_impl)
 
 
 def pp3d_mesh(n_dp: int, n_pp: int, n_sp: int) -> Mesh:
@@ -238,7 +252,7 @@ def pp3d_mesh(n_dp: int, n_pp: int, n_sp: int) -> Mesh:
 
 def make_pp_sp_lm_train_step(
     module, tx, mesh: Mesh, *, n_micro: Optional[int] = None,
-    attn_impl: str = "auto", sp_mode: str = "ring",
+    attn_impl: str = "auto", sp_mode: str = "ring", xent_impl: str = "auto",
 ) -> Callable:
     """GPipe pipeline with sequence-parallel attention INSIDE each stage —
     DeepSpeed-style 3-D (dp, pp, sp) parallelism as ONE jitted program.
@@ -254,4 +268,4 @@ def make_pp_sp_lm_train_step(
     TransformerLM config (its ring fields are overridden here).
     """
     return _make_pp_step(module, tx, mesh, n_micro, attn_impl,
-                         sp_axis="sp", sp_mode=sp_mode)
+                         sp_axis="sp", sp_mode=sp_mode, xent_impl=xent_impl)

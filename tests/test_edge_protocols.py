@@ -1,0 +1,135 @@
+"""Message-driven TurboAggregate and VFL (VERDICT r1 #3): the wire protocols
+over comm/local.py multi-rank (+ gRPC loopback) must reproduce the
+host-simulated forms — the group-relay field total is exact by construction
+(additive masks cancel in the prime field), the guest/host exchange calls the
+same jitted party functions in the same order."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.synthetic import make_synthetic_classification
+from fedml_tpu.models import create_model
+
+
+def _ta_ds(clients=4):
+    return make_synthetic_classification(
+        "ta-edge", (8,), 3, clients, records_per_client=12,
+        partition_method="hetero", partition_alpha=0.5, batch_size=6, seed=2,
+    )
+
+
+def _ta_cfg(clients=4, rounds=2):
+    return FedConfig(
+        model="lr", client_num_in_total=clients, client_num_per_round=clients,
+        comm_round=rounds, epochs=1, batch_size=6, lr=0.3, seed=9,
+        frequency_of_the_test=1, device_data="off",
+    )
+
+
+class TestTurboAggregateEdge:
+    def test_matches_host_simulated_api(self):
+        """End-to-end: the message-driven secure relay equals the
+        host-simulated TurboAggregateAPI. The field totals are bit-equal
+        given equal local updates; the only slack is vmap(C) vs per-worker
+        training numerics, bounded well inside one quantization step."""
+        from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
+        from fedml_tpu.distributed.turboaggregate_edge import run_turboaggregate_edge
+
+        C = 4
+        ds = _ta_ds(C)
+        cfg = _ta_cfg(C, rounds=2)
+        host = TurboAggregateAPI(
+            ds, cfg, create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:]),
+            group_size=2)
+        host.train()
+        server = run_turboaggregate_edge(ds, cfg, group_size=2)
+        # 2^-20 quantization -> one field unit is ~1e-6; allow a couple units
+        for a, b in zip(jax.tree.leaves(host.variables),
+                        jax.tree.leaves(server.variables)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0, atol=4 / (1 << 20))
+        assert server.history["Test/Acc"][-1] is not None
+
+    def test_uneven_groups(self):
+        """C=5, group_size=2 -> 2 round-robin groups of sizes 3+2; the relay
+        must still recover the exact weighted aggregate."""
+        from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
+        from fedml_tpu.distributed.turboaggregate_edge import run_turboaggregate_edge
+
+        C = 5
+        ds = _ta_ds(C)
+        cfg = _ta_cfg(C, rounds=1)
+        host = TurboAggregateAPI(
+            ds, cfg, create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:]),
+            group_size=2)
+        host.train()
+        server = run_turboaggregate_edge(ds, cfg, group_size=2)
+        for a, b in zip(jax.tree.leaves(host.variables),
+                        jax.tree.leaves(server.variables)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0, atol=4 / (1 << 20))
+
+    def test_grpc_loopback(self):
+        """One round of the secure relay over real gRPC sockets."""
+        pytest.importorskip("grpc")
+        from fedml_tpu.comm.grpc_backend import GRPCCommManager
+        from fedml_tpu.distributed.turboaggregate_edge import run_turboaggregate_edge
+
+        C = 4
+        ds = _ta_ds(C)
+        cfg = _ta_cfg(C, rounds=1)
+        size = C + 1
+        server = run_turboaggregate_edge(
+            ds, cfg, group_size=2,
+            comm_factory=lambda r: GRPCCommManager(rank=r, size=size,
+                                                   base_port=56820))
+        assert np.isfinite(server.history["Test/Loss"][-1])
+
+
+class TestVFLEdge:
+    def test_matches_in_process_protocol(self):
+        """The wire run must be BYTE-EQUAL to the in-process guest/host
+        protocol on the same seed: same party objects, same jitted fns,
+        same batch schedule, exact array wire format."""
+        from fedml_tpu.algorithms.vfl import build_protocol_vfl
+        from fedml_tpu.data.vertical import make_synthetic_vertical
+        from fedml_tpu.distributed.vfl_edge import run_vfl_edge
+
+        ds = make_synthetic_vertical((6, 5, 4), n_train=96, n_test=48, seed=7)
+        epochs, bs, seed, lr = 3, 32, 5, 0.05
+
+        # in-process reference: same schedule as VFLGuestManager drives
+        proto = build_protocol_vfl(ds, hidden_dim=8, lr=lr, seed=seed)
+        rng = np.random.default_rng(seed)
+        n = len(ds.train_y)
+        steps = n // bs
+        for _ in range(epochs):
+            order = rng.permutation(n)[: steps * bs].reshape(steps, bs)
+            for b in range(steps):
+                idx = order[b]
+                proto.fit(ds.train_parts[0][idx], ds.train_y[idx],
+                          {p: ds.train_parts[p][idx] for p in range(1, ds.num_parties)})
+
+        guest_mgr = run_vfl_edge(ds, hidden_dim=8, lr=lr, batch_size=bs,
+                                 epochs=epochs, seed=seed)
+
+        for a, b in zip(jax.tree.leaves(proto.guest.params),
+                        jax.tree.leaves(guest_mgr.party.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert "Test/Acc" in guest_mgr.history[-1]
+
+    def test_grpc_loopback(self):
+        pytest.importorskip("grpc")
+        from fedml_tpu.comm.grpc_backend import GRPCCommManager
+        from fedml_tpu.data.vertical import make_synthetic_vertical
+        from fedml_tpu.distributed.vfl_edge import run_vfl_edge
+
+        ds = make_synthetic_vertical((6, 5), n_train=64, n_test=32, seed=3)
+        guest_mgr = run_vfl_edge(
+            ds, hidden_dim=8, lr=0.05, batch_size=32, epochs=1, seed=1,
+            comm_factory=lambda r: GRPCCommManager(rank=r, size=ds.num_parties,
+                                                   base_port=56840))
+        assert np.isfinite(guest_mgr.history[-1]["Test/Loss"])

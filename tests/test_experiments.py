@@ -73,9 +73,12 @@ def test_dispatcher_covers_remaining_standalone_algorithms(algo):
     assert isinstance(out, dict) and out
 
 
-def test_dispatcher_covers_crosssilo():
+@pytest.mark.parametrize("algo", ["crosssilo_fedavg", "crosssilo_fedopt",
+                                  "crosssilo_fednova", "crosssilo_fedagc",
+                                  "crosssilo_fedavg_robust"])
+def test_dispatcher_covers_crosssilo(algo):
     # 8 virtual devices; full participation, cohort == mesh size
-    out = main(_argv("crosssilo_fedavg", client_num_in_total="8",
+    out = main(_argv(algo, client_num_in_total="8",
                      client_num_per_round="8"))
     assert isinstance(out, dict) and out
 
@@ -108,7 +111,9 @@ def test_dispatcher_covers_fednas_and_fedseg_and_nothing_is_missed():
         "fedavg", "fedopt", "fedprox", "fednova", "centralized",
         "turboaggregate",
         # dedicated launcher tests in this file
-        "vfl", "fedgkt", "crosssilo_fedavg", "splitnn", "fednas", "fedseg",
+        "vfl", "fedgkt", "crosssilo_fedavg", "crosssilo_fedopt",
+        "crosssilo_fednova", "crosssilo_fedagc", "crosssilo_fedavg_robust",
+        "splitnn", "fednas", "fedseg",
         # remaining-standalone parametrize
         "fedagc", "fedavg_robust", "hierarchical", "decentralized",
         "silo_fedavg", "silo_fedopt", "silo_fednova", "silo_fedagc",
@@ -133,9 +138,8 @@ def test_every_algorithm_has_a_main_alias():
              for p in exp_dir.glob("main_*.py")}
     # data-loader aliases and silo variants route through their base main
     expected = {a for a in ALGORITHMS
-                if a not in {"crosssilo_fedavg", "lending_club", "nus_wide",
-                             "uci_credit"}
-                and not a.startswith("silo_")}
+                if a not in {"lending_club", "nus_wide", "uci_credit"}
+                and not a.startswith(("silo_", "crosssilo_"))}
     missing = expected - mains
     assert not missing, f"algorithms without a main_*.py alias: {missing}"
     for m in sorted(mains):

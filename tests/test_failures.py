@@ -114,8 +114,10 @@ class TestElasticRounds:
         cm = jnp.ones((8, 4), jnp.float32)
         counts = jnp.zeros((8,), jnp.float32)  # every client failed
         keys = jax.random.split(jax.random.key(1), 8)
-        args = place_round_inputs(mesh, variables, cx, cy, cm, counts, keys)
-        new_vars, loss = round_fn(*args)
+        variables, cx, cy, cm, counts, keys = place_round_inputs(
+            mesh, variables, cx, cy, cm, counts, keys)
+        new_vars, _, loss = round_fn(variables, {}, cx, cy, cm, counts, keys,
+                                     jax.random.key(2))
         assert np.isfinite(float(loss))
         for a, b in zip(jax.tree.leaves(new_vars), jax.tree.leaves(variables)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
