@@ -384,7 +384,10 @@ class FedAvgAPI:
 
     # -- driver --------------------------------------------------------------
 
-    def run_round(self, round_idx: int) -> float:
+    def run_round(self, round_idx: int) -> "float | jax.Array":
+        """Execute one round; returns the weighted train loss — a host float,
+        or (config.async_rounds) the un-synced device scalar so consecutive
+        rounds pipeline; callers that do host arithmetic must float() it."""
         sampled, live, bucket = self._round_plan(round_idx, record=True)
         rk = round_key(self.root_key, round_idx)
         if self._dev_train is not None:
@@ -422,7 +425,7 @@ class FedAvgAPI:
                     jnp.asarray(live_np[perm]),
                     jnp.asarray(perm, jnp.int32), rk
                 )
-                return float(train_loss)
+                return train_loss if self.config.async_rounds else float(train_loss)
             if bucket is None:
                 step = self._round_step_gather
             else:
@@ -444,7 +447,7 @@ class FedAvgAPI:
                 self.variables, self.server_state, cx, cy, cm,
                 jnp.asarray(counts, jnp.float32), rk
             )
-        return float(train_loss)
+        return train_loss if self.config.async_rounds else float(train_loss)
 
     def save(self, path: str, round_idx: int = 0, orbax: bool = False) -> None:
         """Checkpoint variables + server state (+ resume round). The
@@ -515,7 +518,7 @@ class FedAvgAPI:
                 self.history["Test/Acc"].append(m.get("acc"))
                 self.history["Test/Loss"].append(m.get("loss"))
                 logger.log(
-                    {"Train/Loss": loss, "Test/Acc": m.get("acc"),
+                    {"Train/Loss": float(loss), "Test/Acc": m.get("acc"),
                      "Test/Loss": m.get("loss")}, r,
                 )
             if c.checkpoint_dir and (
@@ -599,7 +602,7 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         self.variables, self.server_state, train_loss = self._round_step(
             self.variables, self.server_state, cx, cy, cm, counts, rk
         )
-        return float(train_loss)
+        return train_loss if self.config.async_rounds else float(train_loss)
 
     def build_round_step(self):
         from fedml_tpu.parallel.crosssilo import make_crosssilo_round, place_round_inputs

@@ -61,11 +61,15 @@ class FedNASAPI:
         multiplier: int = 2,
         arch_lr: float = 3e-4,
         arch_wd: float = 1e-3,
+        unrolled: Optional[bool] = None,
     ):
         self.dataset = dataset
         self.config = config
         self.steps_cfg = steps
         self.multiplier = multiplier
+        #: second-order (unrolled) architect; config --unrolled unless overridden
+        self.unrolled = bool(getattr(config, "unrolled", 0)) if unrolled is None \
+            else bool(unrolled)
         self.module = DartsSearchNetwork(
             channels=channels, layers=layers, steps=steps,
             multiplier=multiplier, output_dim=dataset.class_num,
@@ -98,6 +102,10 @@ class FedNASAPI:
         n_pad = int(self.dataset.train_x.shape[1])
         steps = n_pad // bs
         epochs = cfg.epochs
+        unrolled = self.unrolled
+        if unrolled and bs < 2:
+            raise ValueError("unrolled architect splits each batch into "
+                             "train/val halves; batch_size must be >= 2")
 
         def local_search(variables, alphas, x, y, mask, count, rng):
             wopt = wtx.init(variables["params"])
@@ -117,26 +125,68 @@ class FedNASAPI:
                     bx, by, bm, step_idx = batch
                     live = (step_idx < steps_real).astype(jnp.float32)
 
-                    def loss_of(p, a):
+                    def loss_on(p, a, x_, y_, m_):
                         vin = dict(variables)
                         vin["params"] = p
                         logits, new_vars = module.apply(
-                            vin, bx, a, train=True, mutable=["batch_stats"]
+                            vin, x_, a, train=True, mutable=["batch_stats"]
                         )
-                        return _masked_ce(logits, by, bm), new_vars
+                        return _masked_ce(logits, y_, m_), new_vars
 
-                    # 1) architecture step (single-level: same batch,
-                    #    architect.step_single_level:107-125)
-                    a_grads = jax.grad(
-                        lambda a: loss_of(variables["params"], a)[0]
-                    )(alphas)
+                    def loss_of(p, a):
+                        return loss_on(p, a, bx, by, bm)
+
+                    # 1) architecture step
+                    if unrolled:
+                        # second-order architect (architect.py:32-45 +
+                        # _backward_step_unrolled): grad of the VALIDATION
+                        # loss at the weights after one unrolled SGD step on
+                        # the TRAIN loss. The reference approximates the
+                        # second-order term with a finite-difference
+                        # Hessian-vector product (architect.py:85-103); JAX
+                        # differentiates through the inner update EXACTLY.
+                        # Each batch is split 50/50 into train/val halves —
+                        # the static-shape form of the reference's separate
+                        # train/valid queues.
+                        half = bs // 2
+                        bxt, byt, bmt = bx[:half], by[:half], bm[:half]
+                        bxv, byv, bmv = bx[half:], by[half:], bm[half:]
+                        rho, wd_w = 0.9, 3e-4   # matches self._wtx
+                        trace = optax.tree_utils.tree_get(wopt, "trace")
+
+                        def val_after_unroll(a):
+                            g = jax.grad(
+                                lambda p: loss_on(p, a, bxt, byt, bmt)[0]
+                            )(variables["params"])
+                            # torch-SGD unrolled step: w - eta*(rho*buf + g + wd*w)
+                            # (reference _compute_unrolled_model:36-44)
+                            p_un = jax.tree.map(
+                                lambda p, gg, t: p - cfg.lr * (rho * t + gg + wd_w * p),
+                                variables["params"], g, trace,
+                            )
+                            return loss_on(p_un, a, bxv, byv, bmv)[0]
+
+                        a_grads = jax.grad(val_after_unroll)(alphas)
+                    else:
+                        # single-level: same batch (architect
+                        # step_single_level:107-125)
+                        a_grads = jax.grad(
+                            lambda a: loss_of(variables["params"], a)[0]
+                        )(alphas)
                     a_upd, new_aopt = atx.update(a_grads, aopt, alphas)
                     new_alphas = optax.apply_updates(alphas, a_upd)
 
-                    # 2) weight step with the updated alphas
-                    (l, new_vars), w_grads = jax.value_and_grad(
-                        lambda p: loss_of(p, new_alphas), has_aux=True
-                    )(variables["params"])
+                    # 2) weight step with the updated alphas (on the train
+                    #    half when unrolled — the val half is held out)
+                    if unrolled:
+                        (l, new_vars), w_grads = jax.value_and_grad(
+                            lambda p: loss_on(p, new_alphas, bxt, byt, bmt),
+                            has_aux=True,
+                        )(variables["params"])
+                    else:
+                        (l, new_vars), w_grads = jax.value_and_grad(
+                            lambda p: loss_of(p, new_alphas), has_aux=True
+                        )(variables["params"])
                     # reference main_fednas default --grad_clip is 5; a
                     # configured FedConfig.grad_clip overrides it
                     clip = cfg.grad_clip if cfg.grad_clip else 5.0

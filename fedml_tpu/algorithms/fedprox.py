@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
 from fedml_tpu.parallel.local import make_local_train_fn
 
 
@@ -30,3 +30,10 @@ class FedProxAPI(FedAvgAPI):
             prox_mu=c.fedprox_mu,
             compute_dtype=jnp.bfloat16 if c.dtype == "bfloat16" else None,
         )
+
+
+class CrossSiloFedProxAPI(CrossSiloFedAvgAPI, FedProxAPI):
+    """FedProx on the cross-silo mesh path: the proximal term is entirely
+    client-side (build_local_train), aggregation is plain weighted psum —
+    the MRO composes the two with no extra code (the reference would run
+    this as its fedprox MPI deployment, which is FedAvg's)."""

@@ -63,6 +63,9 @@ class FedConfig:
     attack_type: Optional[str] = None
     poison_frac: float = 0.0
 
+    # FedNAS (main_fednas.py --unrolled: second-order DARTS architect)
+    unrolled: int = 0
+
     # FedGKT (main_fedgkt.py:37-88)
     temperature: float = 3.0
     alpha_distill: float = 1.0
@@ -81,6 +84,11 @@ class FedConfig:
     mesh_shape: tuple = ()           # e.g. (8,) client axis; () = auto
     dtype: str = "float32"           # compute dtype: float32 | bfloat16
     donate: bool = True
+    # Defer the per-round host sync: run_round returns the loss as a device
+    # scalar instead of float()ing it, so consecutive rounds pipeline through
+    # the dispatch queue (the remote-compile tunnel costs ~100 ms per forced
+    # sync; eval/logging rounds still sync when they read the value).
+    async_rounds: bool = False
     # Keep the full stacked client dataset resident in HBM and gather the
     # sampled cohort ON DEVICE each round ("auto"|"on"|"off"). The reference
     # re-ships the cohort host->device every round (its DataLoader contract);
@@ -191,6 +199,7 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--comm_round", type=int, default=defaults.comm_round)
     p.add_argument("--group_num", type=int, default=defaults.group_num)
     p.add_argument("--group_comm_round", type=int, default=defaults.group_comm_round)
+    p.add_argument("--unrolled", type=int, default=defaults.unrolled)
     p.add_argument("--batch_size", type=int, default=defaults.batch_size)
     p.add_argument("--client_optimizer", type=str, default=defaults.client_optimizer)
     p.add_argument("--lr", type=float, default=defaults.lr)

@@ -53,6 +53,50 @@ def _synthesize_edge_cases(
     return x, y_true.astype(base.train_y.dtype)
 
 
+# CIFAR-10 channel statistics the reference's transform pipeline applies to
+# the raw uint8 southwest images (data_loader.py:330-339)
+_CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+_CIFAR_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
+
+# archive filenames per attack case (reference data_loader.py:344-361)
+_SOUTHWEST_FILES = {
+    "edge-case": ("southwest_images_new_train.pkl",
+                  "southwest_images_new_test.pkl"),
+    "normal-case": ("southwest_images_adv_p_percent_edge_case.pkl",
+                    "southwest_images_p_percent_edge_case_test.pkl"),
+    "almost-edge-case": ("southwest_images_adv_p_percent_edge_case.pkl",
+                         "southwest_images_p_percent_edge_case_test.pkl"),
+}
+
+
+def _load_southwest_archives(data_dir: str, attack_case: str, base: FedDataset):
+    """Parse the reference's REAL southwest archives — raw [N, 32, 32, 3]
+    uint8 ndarray pickles under edge_case_examples/southwest_cifar10/
+    (data_loader.py:344-376; labels are implicit — every image is relabeled
+    to the attack target, true class 'airplane'). Returns
+    (train_x, test_x) in the base dataset's dtype/normalization, or None
+    when the files are absent (zero-egress fallback)."""
+    names = _SOUTHWEST_FILES.get(attack_case)
+    if names is None:
+        return None
+    sw_dir = os.path.join(data_dir, "edge_case_examples", "southwest_cifar10")
+    paths = [os.path.join(sw_dir, n) for n in names]
+    if not all(os.path.exists(p) for p in paths):
+        return None
+    out = []
+    for p in paths:
+        with open(p, "rb") as f:
+            arr = np.asarray(pickle.load(f))
+        if arr.ndim != 4 or arr.shape[1:] != tuple(base.train_x.shape[2:]):
+            raise ValueError(
+                f"southwest archive {p}: expected raw images "
+                f"[N, {base.train_x.shape[2:]}], got {arr.shape}")
+        if arr.dtype == np.uint8:  # reference transform: ToTensor + Normalize
+            arr = (arr.astype(np.float32) / 255.0 - _CIFAR_MEAN) / _CIFAR_STD
+        out.append(arr.astype(base.train_x.dtype))
+    return out[0], out[1]
+
+
 def load_poisoned_dataset(
     base: FedDataset,
     attack_case: str = "edge-case",
@@ -65,18 +109,30 @@ def load_poisoned_dataset(
     """Inject edge-case poison into `attacker_clients` (default: client 1,
     like the reference's rank-1 attacker, FedAvgRobustTrainer.py:14-25).
 
-    With real archives ({data_dir}/edge_case_examples/southwest.pkl, etc.)
-    the genuine edge images are used; otherwise the synthetic edge cluster.
-    ``poison_frac`` of each attacker's padded slots are replaced.
+    With real archives the genuine edge images are used — the reference's
+    southwest layout ({data_dir}/edge_case_examples/southwest_cifar10/
+    southwest_images_new_{train,test}.pkl, raw uint8 image stacks) or the
+    generic {attack_case}.pkl dict {"x", "y_true"} — otherwise the synthetic
+    edge cluster. ``poison_frac`` of each attacker's real records are
+    replaced.
     """
     rng = np.random.default_rng(seed)
     attacker_clients = attacker_clients if attacker_clients is not None else [1]
     path = os.path.join(data_dir, "edge_case_examples", f"{attack_case.replace('-', '_')}.pkl")
     n_pad = base.train_x.shape[1]
+    # pool-sizing estimate for the synthetic fallback (upper bound); the
+    # ACTUAL per-attacker poison count is poison_frac of that attacker's
+    # REAL record count, computed in the injection loop below.
     # poison_frac=0 must mean a genuinely clean control federation
     n_poison_per = max(int(n_pad * poison_frac), 1) if poison_frac > 0 else 0
 
-    if os.path.exists(path):
+    southwest = _load_southwest_archives(data_dir, attack_case, base)
+    edge_test_from_archive = None
+    if southwest is not None:
+        edge_x, edge_test_from_archive = southwest
+        # southwest true class is 'airplane' (reference relabels 0 -> 9)
+        edge_true = np.zeros(len(edge_x), base.train_y.dtype)
+    elif os.path.exists(path):
         with open(path, "rb") as f:
             blob = pickle.load(f)
         edge_x = np.asarray(blob["x"], base.train_x.dtype)
@@ -95,15 +151,22 @@ def load_poisoned_dataset(
         # relies on — padded slots never train, so flipping their mask
         # would silently shrink the effective poison
         n_real = int(base.train_counts[c])
-        take = min(n_poison_per, len(edge_x) - used, n_real)
+        n_poison = max(int(n_real * poison_frac), 1) if poison_frac > 0 else 0
+        take = min(n_poison, len(edge_x) - used, n_real)
         slots = rng.choice(n_real, take, replace=False)
         train_x[c, slots] = edge_x[used : used + take]
         train_y[c, slots] = target_class
         used += take
 
-    # remaining edge cases form the backdoor test set
-    edge_test_x = edge_x[used:]
-    edge_test_true = edge_true[used:]
+    # backdoor test set: the archive's dedicated test images (reference
+    # keeps southwest_*_test.pkl as the targeted task test set) or the
+    # leftover edge cases
+    if edge_test_from_archive is not None:
+        edge_test_x = edge_test_from_archive
+        edge_test_true = np.zeros(len(edge_test_x), base.train_y.dtype)
+    else:
+        edge_test_x = edge_x[used:]
+        edge_test_true = edge_true[used:]
     import dataclasses
 
     poisoned = dataclasses.replace(

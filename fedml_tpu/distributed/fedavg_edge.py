@@ -243,9 +243,11 @@ class FedAvgEdgeClientManager(ClientManager):
         self.send_message(out)
 
 
-def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = True):
+def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = True,
+                    comm_factory=None):
     """In-process launch: 1 server + worker_num clients over the local
-    transport (the reference's mpirun path, FedAvgAPI.py:20-28). Returns the
+    transport (the reference's mpirun path, FedAvgAPI.py:20-28) or a real
+    transport via ``comm_factory`` (e.g. gRPC loopback). Returns the
     server's aggregator (holding the final global model + test history)."""
     from fedml_tpu.core.rng import seed_everything
 
@@ -271,5 +273,6 @@ def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = Tru
         trainer = FedAVGTrainer(dataset, bundle, config)
         return FedAvgEdgeClientManager(args, comm, rank, size, trainer, root_key)
 
-    run_ranks(make, size, wire_roundtrip=wire_roundtrip)
+    run_ranks(make, size, wire_roundtrip=wire_roundtrip,
+              comm_factory=comm_factory)
     return aggregator

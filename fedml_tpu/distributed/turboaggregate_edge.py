@@ -29,8 +29,6 @@ field-uniform until the final total is unmasked at the server.
 
 from __future__ import annotations
 
-import logging
-
 import jax
 import numpy as np
 
@@ -47,8 +45,6 @@ from fedml_tpu.core.rng import round_key, seed_everything
 from fedml_tpu.core.tasks import get_task
 from fedml_tpu.models import create_model
 from fedml_tpu.parallel.local import finalize_metrics, make_eval_fn, make_local_train_fn
-
-LOG = logging.getLogger(__name__)
 
 MSG_TYPE_S2C_SYNC = "ta_sync"        # server -> clients: model + round + weight
 MSG_TYPE_C2C_SHARE = "ta_share"      # additive share to a group-mate
@@ -93,7 +89,6 @@ class TAEdgeServerManager(ServerManager):
         self._dtypes = [l.dtype for l in leaves]
         counts = np.asarray(dataset.train_counts, np.float64)[: size - 1]
         self._weights = counts / counts.sum()
-        self._counts = counts
 
     def run(self):
         self.register_message_receive_handlers()

@@ -20,8 +20,6 @@ and the wire format round-trips arrays exactly.
 
 from __future__ import annotations
 
-import logging
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -35,8 +33,6 @@ from fedml_tpu.algorithms.vfl import (
 )
 from fedml_tpu.comm import ClientManager, Message, ServerManager
 from fedml_tpu.comm.local import run_ranks
-
-LOG = logging.getLogger(__name__)
 
 MSG_TYPE_G2H_BATCH = "vfl_batch"       # guest -> host: row indices
 MSG_TYPE_H2G_COMPONENT = "vfl_comp"    # host -> guest: logit component

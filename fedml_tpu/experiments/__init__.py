@@ -26,7 +26,7 @@ ALGORITHMS = (
     "fedgkt", "fednas", "fedseg", "splitnn", "vfl", "centralized",
     "silo_fedavg", "silo_fedopt", "silo_fednova", "silo_fedagc",
     "crosssilo_fedopt", "crosssilo_fednova", "crosssilo_fedagc",
-    "crosssilo_fedavg_robust",
+    "crosssilo_fedavg_robust", "crosssilo_fedprox",
 )
 
 
@@ -140,7 +140,7 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
     from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
     from fedml_tpu.algorithms.fednova import CrossSiloFedNovaAPI, FedNovaAPI
     from fedml_tpu.algorithms.fedopt import CrossSiloFedOptAPI, FedOptAPI
-    from fedml_tpu.algorithms.fedprox import FedProxAPI
+    from fedml_tpu.algorithms.fedprox import CrossSiloFedProxAPI, FedProxAPI
     from fedml_tpu.algorithms.fedseg import FedSegAPI
     from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
     from fedml_tpu.algorithms.robust import CrossSiloFedAvgRobustAPI, FedAvgRobustAPI
@@ -154,6 +154,7 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
         "crosssilo_fednova": CrossSiloFedNovaAPI,
         "crosssilo_fedagc": CrossSiloFedAGCAPI,
         "crosssilo_fedavg_robust": CrossSiloFedAvgRobustAPI,
+        "crosssilo_fedprox": CrossSiloFedProxAPI,
         "fedopt": FedOptAPI,
         "fedprox": FedProxAPI,
         "fednova": FedNovaAPI,

@@ -45,7 +45,10 @@ def _assert_matches(sim, dist, tol=1e-5):
     d = float(tree_global_norm(tree_sub(sim.variables["params"], dist.variables["params"])))
     s = float(tree_global_norm(sim.variables["params"]))
     assert d / max(s, 1e-9) < tol, f"relative diff {d / s:.2e}"
-    # server state must match too (FedOpt moments etc.)
+    # server state must match too (FedOpt moments etc.) — structure first,
+    # so a dropped state entry can't truncate the zip
+    assert (jax.tree.structure(sim.server_state)
+            == jax.tree.structure(dist.server_state))
     for a, b in zip(jax.tree.leaves(sim.server_state), jax.tree.leaves(dist.server_state)):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), rtol=2e-5, atol=1e-6)
@@ -98,6 +101,15 @@ class TestCrossSiloZoo:
         b_sim = sim.evaluate_backdoor()["backdoor_success"]
         b_dist = dist.evaluate_backdoor()["backdoor_success"]
         assert np.isclose(b_sim, b_dist, atol=1e-6)
+
+    def test_fedprox_matches_simulation(self):
+        from fedml_tpu.algorithms.fedprox import CrossSiloFedProxAPI, FedProxAPI
+
+        ds = _ds("xz-prox", seed=9)
+        kw = dict(fedprox_mu=0.5)
+        sim = FedProxAPI(ds, _cfg(**kw), _bundle(ds))
+        dist = CrossSiloFedProxAPI(ds, _cfg(**kw), _bundle(ds), mesh=client_mesh(C))
+        _assert_matches(sim, dist)
 
     def test_fedopt_elastic_all_fail_rolls_back_state(self):
         """All-failed round on the mesh path: weights AND server-optimizer

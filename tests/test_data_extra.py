@@ -65,6 +65,73 @@ def test_poison_frac_zero_is_clean_control():
     np.testing.assert_array_equal(pf.dataset.train_y, base.train_y)
 
 
+def test_southwest_real_archive_parse_path(tmp_path):
+    """REAL southwest archive parsing (reference data_loader.py:344-376):
+    write tiny raw-uint8 image-stack pickles in the reference's layout and
+    verify the loader normalizes them with the CIFAR statistics, poisons the
+    attacker with them, and uses the dedicated test pickle as the backdoor
+    eval set (true class airplane=0, relabeled to truck=9)."""
+    import pickle
+
+    from fedml_tpu.data.edge_cases import _CIFAR_MEAN, _CIFAR_STD
+
+    base = make_synthetic_classification(
+        "sw", (32, 32, 3), 10, 4, records_per_client=12,
+        partition_method="homo", batch_size=4, seed=0,
+    )
+    sw_dir = tmp_path / "edge_case_examples" / "southwest_cifar10"
+    sw_dir.mkdir(parents=True)
+    rng = np.random.default_rng(7)
+    raw_train = rng.integers(0, 256, (10, 32, 32, 3), dtype=np.uint8)
+    raw_test = rng.integers(0, 256, (6, 32, 32, 3), dtype=np.uint8)
+    with open(sw_dir / "southwest_images_new_train.pkl", "wb") as f:
+        pickle.dump(raw_train, f)
+    with open(sw_dir / "southwest_images_new_test.pkl", "wb") as f:
+        pickle.dump(raw_test, f)
+
+    pf = load_poisoned_dataset(base, attack_case="edge-case", target_class=9,
+                               attacker_clients=[1], poison_frac=0.5,
+                               data_dir=str(tmp_path), seed=3)
+    expect_train = ((raw_train.astype(np.float32) / 255.0 - _CIFAR_MEAN)
+                    / _CIFAR_STD).astype(base.train_x.dtype)
+    expect_test = ((raw_test.astype(np.float32) / 255.0 - _CIFAR_MEAN)
+                   / _CIFAR_STD).astype(base.train_x.dtype)
+    # the attacker's poisoned slots hold the normalized archive images,
+    # relabeled to the target
+    poisoned_rows = {tuple(np.round(r.ravel()[:8], 5))
+                     for c in pf.attacker_clients
+                     for r, y in zip(pf.dataset.train_x[c], pf.dataset.train_y[c])
+                     if y == 9}
+    archive_rows = {tuple(np.round(r.ravel()[:8], 5)) for r in expect_train}
+    assert poisoned_rows and poisoned_rows <= archive_rows
+    # backdoor eval set is the archive's TEST pickle, true class airplane
+    np.testing.assert_allclose(pf.edge_test_x, expect_test, rtol=1e-6)
+    assert np.all(pf.edge_test_y == 9)
+    assert np.all(pf.edge_test_true_y == 0)
+    # clean client untouched
+    np.testing.assert_array_equal(pf.dataset.train_x[0], base.train_x[0])
+
+
+def test_southwest_archive_shape_mismatch_raises(tmp_path):
+    import pickle
+
+    import pytest
+
+    base = make_synthetic_classification(
+        "sw2", (8, 8, 3), 10, 2, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=0,
+    )
+    sw_dir = tmp_path / "edge_case_examples" / "southwest_cifar10"
+    sw_dir.mkdir(parents=True)
+    bad = np.zeros((4, 32, 32, 3), np.uint8)  # base is 8x8, archive 32x32
+    for n in ("southwest_images_new_train.pkl", "southwest_images_new_test.pkl"):
+        with open(sw_dir / n, "wb") as f:
+            pickle.dump(bad, f)
+    with pytest.raises(ValueError, match="southwest archive"):
+        load_poisoned_dataset(base, attack_case="edge-case",
+                              data_dir=str(tmp_path))
+
+
 def test_synthesized_edge_cases_exclude_target_class():
     from fedml_tpu.data.edge_cases import _synthesize_edge_cases
 

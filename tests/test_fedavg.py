@@ -46,6 +46,36 @@ class TestEquivalence:
         scale = float(tree_global_norm(cen.variables["params"]))
         assert diff / max(scale, 1e-9) < 1e-4, f"fed!=centralized: rel diff {diff/scale}"
 
+    def test_fedavg_conv_full_participation_equals_centralized(self):
+        """The strongest gate on a CONV architecture (a compensating gate
+        for the flagship CIFAR parity that zero-egress cannot validate):
+        exact because the cnn model is per-sample deterministic (no BN
+        cross-batch coupling), so the weighted mean of per-client full-batch
+        gradients IS the centralized full-batch gradient."""
+        ds = make_synthetic_classification(
+            "convq", (12, 12, 1), 3, 4, records_per_client=8,
+            partition_method="homo", batch_size=8, seed=2,
+        )
+        n_pad = ds.train_x.shape[1]
+        fed_cfg = FedConfig(
+            model="cnn", dataset="convq", client_num_in_total=4,
+            client_num_per_round=4, comm_round=3, epochs=1,
+            batch_size=n_pad, lr=0.2, frequency_of_the_test=10, seed=5,
+        )
+        fed = FedAvgAPI(ds, fed_cfg,
+                        create_model("cnn", ds.class_num,
+                                     input_shape=ds.train_x.shape[2:]))
+        fed.train()
+        total = int(ds.train_counts.sum())
+        cen = CentralizedTrainer(
+            ds, fed_cfg.replace(batch_size=total),
+            create_model("cnn", ds.class_num, input_shape=ds.train_x.shape[2:]))
+        cen.train()
+        diff = float(tree_global_norm(tree_sub(fed.variables["params"],
+                                               cen.variables["params"])))
+        scale = float(tree_global_norm(cen.variables["params"]))
+        assert diff / max(scale, 1e-9) < 1e-4, f"conv fed!=centralized: {diff/scale}"
+
     def test_weighted_aggregation_respects_sample_counts(self):
         # clients with very different sizes must not contribute equally
         ds = _tiny_dataset()

@@ -4,10 +4,13 @@ final model is a legitimate FedAvg result (loss decreases, eval history
 recorded). Counterpart of the reference's distributed CI runs over real MPI
 (run_fedavg_distributed_pytorch.sh) executed in-process."""
 
+import jax
 import numpy as np
+import pytest
 
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data import load_dataset
+from fedml_tpu.data.synthetic import make_synthetic_classification
 from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
 
 
@@ -33,3 +36,65 @@ def test_fedavg_edge_runs_and_improves():
     # round-to-round, so compare the best round against round 0)
     assert min(h["loss"] for h in hist[1:]) < hist[0]["loss"]
     assert max(h["acc"] for h in hist[1:]) > max(0.25, hist[0]["acc"])
+
+
+def _equiv_setup():
+    """Config under which the edge protocol is numerically equivalent to the
+    simulation paradigm: full-batch local epochs (n_pad == batch_size), so
+    the two paths' different per-client key derivations (fold_in(ci) vs
+    split[position]) only permute records WITHIN the single batch — a
+    sum-invariant — and 1 sampled client per worker."""
+    C = 8
+    ds = make_synthetic_classification(
+        "edge-eq", (8,), 3, C, records_per_client=12,
+        partition_method="hetero", partition_alpha=0.5, batch_size=12, seed=4,
+    )
+    n_pad = int(ds.train_x.shape[1])  # hetero partition -> ragged counts;
+    cfg = FedConfig(                  # bs = n_pad keeps every epoch one batch
+        model="lr", dataset="edge-eq", client_num_in_total=C,
+        client_num_per_round=4, comm_round=4, batch_size=n_pad, lr=0.2,
+        momentum=0.9, epochs=2, frequency_of_the_test=1, seed=11,
+        device_data="off",
+    )
+    return ds, cfg
+
+
+def _assert_edge_matches_sim(ds, cfg, agg):
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.models import create_model
+
+    sim = FedAvgAPI(ds, cfg, create_model("lr", ds.class_num,
+                                          input_shape=ds.train_x.shape[2:]))
+    hist = sim.train()
+    for a, b in zip(jax.tree.leaves(sim.variables), jax.tree.leaves(agg.variables)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # per-round server eval metrics must match the simulation's too
+    assert len(agg.test_history) == cfg.comm_round
+    for r, h in enumerate(agg.test_history):
+        assert h["round"] == hist["round"][r]
+        np.testing.assert_allclose(h["acc"], hist["Test/Acc"][r], rtol=1e-6)
+        np.testing.assert_allclose(h["loss"], hist["Test/Loss"][r], rtol=1e-4)
+
+
+def test_fedavg_edge_matches_simulation_numerically():
+    """VERDICT r1 #9: the message-driven star must MATCH the simulation
+    paradigm's weights and metrics, not merely improve."""
+    ds, cfg = _equiv_setup()
+    agg = run_fedavg_edge(ds, cfg, worker_num=cfg.client_num_per_round,
+                          wire_roundtrip=True)
+    _assert_edge_matches_sim(ds, cfg, agg)
+
+
+def test_fedavg_edge_grpc_matches_simulation():
+    """Same equivalence with the full round loop over real gRPC sockets."""
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    ds, cfg = _equiv_setup()
+    size = cfg.client_num_per_round + 1
+    agg = run_fedavg_edge(
+        ds, cfg, worker_num=cfg.client_num_per_round,
+        comm_factory=lambda r: GRPCCommManager(rank=r, size=size,
+                                               base_port=56860))
+    _assert_edge_matches_sim(ds, cfg, agg)

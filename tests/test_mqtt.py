@@ -1,0 +1,158 @@
+"""MQTT backend exercised WITHOUT paho/broker (VERDICT r1 #6): a fake
+in-process paho client implements the pub/sub surface the backend uses, so
+the reference topic scheme (server listens on topic<cid>, clients on
+topic0_<cid> — mqtt_comm_manager.py:47-70) and the binary Message payloads
+are tested end-to-end, including driving the manager runtimes over it."""
+
+import numpy as np
+import pytest
+
+import fedml_tpu.comm.mqtt_backend as mqtt_backend
+from fedml_tpu.comm import ClientManager, Message, ServerManager
+from fedml_tpu.comm.message import MSG_ARG_KEY_MODEL_PARAMS
+
+
+class _FakeBroker:
+    """Topic -> subscribed fake clients; publish delivers synchronously."""
+
+    def __init__(self):
+        self.subs: dict[str, list] = {}
+
+    def subscribe(self, topic, client):
+        self.subs.setdefault(topic, []).append(client)
+
+    def publish(self, topic, payload):
+        for c in self.subs.get(topic, []):
+            c.on_message(c, None, _FakeMsg(topic, payload))
+
+
+class _FakeMsg:
+    def __init__(self, topic, payload):
+        self.topic = topic
+        self.payload = payload
+
+
+def _fake_paho(broker):
+    class Client:
+        def __init__(self, client_id="", protocol=None):
+            self._id = client_id
+            self.on_connect = None
+            self.on_message = None
+
+        def connect(self, host, port):
+            pass
+
+        def loop_start(self):
+            # paho fires on_connect from its network loop; the fake fires it
+            # here so subscriptions happen at the same lifecycle point
+            if self.on_connect:
+                self.on_connect(self, None, None, 0)
+
+        def subscribe(self, topic):
+            broker.subscribe(topic, self)
+
+        def publish(self, topic, payload=b""):
+            broker.publish(topic, payload)
+
+        def loop_stop(self):
+            pass
+
+        def disconnect(self):
+            pass
+
+    class fake:
+        pass
+
+    fake.Client = Client
+    fake.MQTTv311 = 4
+    return fake
+
+
+@pytest.fixture
+def mqtt_env(monkeypatch):
+    broker = _FakeBroker()
+    monkeypatch.setattr(mqtt_backend, "_mqtt", _fake_paho(broker))
+    monkeypatch.setattr(mqtt_backend, "HAS_PAHO", True)
+    return broker
+
+
+def test_topic_scheme_and_payload_roundtrip(mqtt_env):
+    broker = mqtt_env
+    server = mqtt_backend.MqttCommManager("localhost", 1883, client_id=0, client_num=2)
+    c1 = mqtt_backend.MqttCommManager("localhost", 1883, client_id=1, client_num=2)
+    c2 = mqtt_backend.MqttCommManager("localhost", 1883, client_id=2, client_num=2)
+
+    # reference topic scheme: server on topic<cid>, clients on topic0_<cid>
+    assert set(broker.subs) == {"fedml1", "fedml2", "fedml0_1", "fedml0_2"}
+
+    # client -> server carries the full binary Message wire format
+    up = Message("up", 1, 0)
+    up.add_params(MSG_ARG_KEY_MODEL_PARAMS,
+                  {"w": np.arange(6, dtype=np.float32).reshape(2, 3)})
+    c1.send_message(up)
+    got = server._inbox.get_nowait()
+    assert got.get_type() == "up" and got.get_sender_id() == 1
+    np.testing.assert_array_equal(got.get(MSG_ARG_KEY_MODEL_PARAMS)["w"],
+                                  np.arange(6, dtype=np.float32).reshape(2, 3))
+
+    # server -> client 2 rides topic0_2, not topic0_1
+    down = Message("down", 0, 2)
+    down.add_params("x", 7)
+    server.send_message(down)
+    assert c2._inbox.get_nowait().get("x") == 7
+    assert c1._inbox.empty()
+
+
+def test_peer_to_peer_rejected(mqtt_env):
+    c1 = mqtt_backend.MqttCommManager("localhost", 1883, client_id=1, client_num=2)
+    with pytest.raises(NotImplementedError):
+        c1.send_message(Message("p2p", 1, 2))
+
+
+def test_manager_runtime_over_mqtt(mqtt_env):
+    """Drive the ClientManager/ServerManager dispatch loop over the MQTT
+    transport (star ping/pong), proving the backend serves the same manager
+    runtime as LOCAL/gRPC."""
+    from fedml_tpu.comm.local import run_ranks
+
+    size = 3
+
+    class PingServer(ServerManager):
+        def __init__(self, *a):
+            super().__init__(*a)
+            self.got = []
+
+        def run(self):
+            self.register_message_receive_handlers()
+            for r in range(1, self.size):
+                self.send_message(Message("ping", self.rank, r))
+            self.com_manager.handle_receive_message()
+
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler("pong", self._on_pong)
+
+        def _on_pong(self, msg):
+            self.got.append((msg.get_sender_id(), int(msg.get("x"))))
+            if len(self.got) == self.size - 1:
+                self.finish()
+
+    class PongClient(ClientManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler("ping", self._on_ping)
+
+        def _on_ping(self, msg):
+            out = Message("pong", self.rank, 0)
+            out.add_params("x", self.rank * 10)
+            self.send_message(out)
+            self.finish()
+
+    def comm_factory(rank):
+        return mqtt_backend.MqttCommManager("localhost", 1883,
+                                            client_id=rank, client_num=size - 1)
+
+    def make(rank, comm):
+        cls = PingServer if rank == 0 else PongClient
+        return cls(None, comm, rank, size)
+
+    managers = run_ranks(make, size, comm_factory=comm_factory)
+    assert sorted(managers[0].got) == [(1, 10), (2, 20)]
