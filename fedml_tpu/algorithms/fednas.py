@@ -41,6 +41,12 @@ from fedml_tpu.models.darts import (
 
 log = logging.getLogger(__name__)
 
+# weight-optimizer hyperparameters (reference main_fednas defaults) — shared
+# by self._wtx AND the unrolled architect's inner SGD step, which must stay
+# in lockstep with the real optimizer
+W_MOMENTUM = 0.9
+W_WEIGHT_DECAY = 3e-4
+
 
 def _masked_ce(logits, labels, mask):
     per = int_cross_entropy(logits, labels)
@@ -84,8 +90,8 @@ class FedNASAPI:
         # weight optimizer: SGD momentum 0.9 wd 3e-4 (reference main_fednas
         # defaults); arch optimizer: Adam lr 3e-4 wd 1e-3 (architect.py:23-27)
         self._wtx = optax.chain(
-            optax.add_decayed_weights(3e-4),
-            optax.sgd(config.lr, momentum=0.9),
+            optax.add_decayed_weights(W_WEIGHT_DECAY),
+            optax.sgd(config.lr, momentum=W_MOMENTUM),
         )
         self._atx = optax.chain(
             optax.add_decayed_weights(arch_wd), optax.adam(arch_lr)
@@ -151,7 +157,7 @@ class FedNASAPI:
                         half = bs // 2
                         bxt, byt, bmt = bx[:half], by[:half], bm[:half]
                         bxv, byv, bmv = bx[half:], by[half:], bm[half:]
-                        rho, wd_w = 0.9, 3e-4   # matches self._wtx
+                        rho, wd_w = W_MOMENTUM, W_WEIGHT_DECAY
                         trace = optax.tree_utils.tree_get(wopt, "trace")
 
                         def val_after_unroll(a):

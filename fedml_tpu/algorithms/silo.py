@@ -78,7 +78,10 @@ class SiloRunner:
         cfg = self.api.config
         stall = 0
         for r in range(cfg.comm_round):
-            train_loss = self.api.run_round(r)
+            # float() per run_round's contract: under async_rounds the
+            # return is an un-synced device scalar, and this history is
+            # host data (json-serialized by history_save_fn)
+            train_loss = float(self.api.run_round(r))
             gm = self.api.evaluate_global()
             val = self._validation_metric(gm)
             self.history["round"].append(r)

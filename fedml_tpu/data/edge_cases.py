@@ -163,7 +163,10 @@ def load_poisoned_dataset(
     # leftover edge cases
     if edge_test_from_archive is not None:
         edge_test_x = edge_test_from_archive
-        edge_test_true = np.zeros(len(edge_test_x), base.train_y.dtype)
+        # same true class as the train archive (airplane=0, set where the
+        # southwest branch builds edge_true)
+        edge_test_true = np.full(len(edge_test_x), edge_true[0] if len(edge_true)
+                                 else 0, base.train_y.dtype)
     else:
         edge_test_x = edge_x[used:]
         edge_test_true = edge_true[used:]

@@ -120,6 +120,33 @@ class TestConvergence:
         assert np.isfinite(hist["Test/Loss"][-1])
 
 
+class TestAsyncRounds:
+    def test_async_rounds_match_sync(self):
+        """config.async_rounds only defers the host sync — the trained
+        variables must be identical to the synchronous path, and the
+        returned loss must be a device scalar that floats to the same
+        value."""
+        ds = _tiny_dataset()
+        kw = dict(model="lr", client_num_in_total=4, client_num_per_round=4,
+                  comm_round=3, epochs=1, batch_size=8, lr=0.3, seed=9,
+                  frequency_of_the_test=100)
+        sync = FedAvgAPI(ds, FedConfig(**kw),
+                         create_model("lr", ds.class_num,
+                                      input_shape=ds.train_x.shape[2:]))
+        asyn = FedAvgAPI(ds, FedConfig(async_rounds=True, **kw),
+                         create_model("lr", ds.class_num,
+                                      input_shape=ds.train_x.shape[2:]))
+        for r in range(3):
+            l_s = sync.run_round(r)
+            l_a = asyn.run_round(r)
+            assert isinstance(l_s, float)
+            assert not isinstance(l_a, float)   # un-synced device scalar
+            assert np.isclose(l_s, float(l_a), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(sync.variables),
+                        jax.tree.leaves(asyn.variables)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestSampling:
     def test_partial_participation_deterministic(self):
         ds = _tiny_dataset()
