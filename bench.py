@@ -137,7 +137,7 @@ def main():
     # bucket's XLA program is compiled before the timed pass (run_round(r)
     # samples deterministically from r, so the timed pass reuses the exact
     # same programs — warm exactly the measured rounds 1..N).
-    # run_round syncs on the returned loss each call.
+    # (async_rounds: no per-round sync — the trailing float() barriers.)
     # NB: block_until_ready on tunnel-backed arrays returns without waiting
     # (remote async completion), so the end-of-pass barrier is float() of the
     # LAST round's loss — it data-depends on every prior round, and pulling

@@ -158,5 +158,8 @@ def test_bench_tiny_smoke(monkeypatch, capsys):
     bench.main()
     line = capsys.readouterr().out.strip().splitlines()[-1]
     out = json.loads(line)
-    assert {"metric", "value", "unit", "vs_baseline"} <= set(out)
+    assert {"metric", "value", "unit", "vs_baseline",
+            "model_flops_per_image", "mfu"} <= set(out)
     assert out["value"] > 0
+    # XLA cost-model FLOP accounting must be live (mfu itself is None off-TPU)
+    assert out["model_flops_per_image"] and out["model_flops_per_image"] > 0
