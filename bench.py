@@ -37,12 +37,15 @@ _PEAK_BF16 = (
 )
 
 
-def _peak_flops(device) -> float | None:
+def _peak_flops(device):
+    """(peak_bf16_flops, matched_table_entry) — the entry is reported in the
+    bench JSON so a future device kind silently substring-matching an old
+    entry (e.g. a 'v6p' hitting 'v6') is visible, not a wrong number."""
     kind = getattr(device, "device_kind", "").lower()
     for frag, peak in _PEAK_BF16:
         if frag in kind:
-            return peak
-    return None
+            return peak, frag
+    return None, None
 
 
 def _fwd_flops_per_image(bundle, variables, input_shape, batch, dtype):
@@ -71,10 +74,10 @@ def _fwd_flops_per_image(bundle, variables, input_shape, batch, dtype):
                 ca = ca[0]
             flops = float(ca.get("flops", 0.0))
             if flops > 0:
-                return flops / batch
+                return flops / batch, backend or jax.default_backend()
         except Exception:
             continue
-    return None
+    return None, None
 
 # Bench config (north star: 32 non-IID clients, ResNet-56, CIFAR-10 shapes)
 NUM_CLIENTS = 32
@@ -171,10 +174,10 @@ def main():
     # mfu = padded_rate * train_flops_per_image / device bf16 peak — the
     # honest device-utilization number for the roofline discussion
     # (VERDICT r1 weak#1; see docs/perf.md).
-    fwd_flops = _fwd_flops_per_image(
+    fwd_flops, flops_backend = _fwd_flops_per_image(
         bundle, api.variables, ds.train_x.shape[2:], batch, jnp.bfloat16)
     train_flops = fwd_flops * 3.0 if fwd_flops else None
-    peak = _peak_flops(jax.devices()[0])
+    peak, peak_entry = _peak_flops(jax.devices()[0])
     mfu = (round(padded_images / dt * train_flops / peak, 4)
            if (train_flops and peak) else None)
 
@@ -187,6 +190,14 @@ def main():
         "padded_images_per_sec": round(padded_images / dt, 1),
         "model_flops_per_image": round(train_flops) if train_flops else None,
         "mfu": mfu,
+        # mfu is an ESTIMATE: fwd FLOPs from XLA's cost model on the named
+        # backend x3 for the train step, over the bf16 peak of the matched
+        # spec-table entry — provenance recorded so a cost-model change or a
+        # wrong peak-table substring match is visible in the JSON itself
+        "mfu_basis": {"flops_cost_model_backend": flops_backend,
+                      "fwd_bwd_multiplier": 3.0,
+                      "peak_table_entry": peak_entry,
+                      "peak_bf16_flops": peak},
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(result))

@@ -26,7 +26,7 @@ import numpy as np
 
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.core.pytree import tree_weighted_mean
-from fedml_tpu.core.rng import round_key, sample_clients, seed_everything
+from fedml_tpu.core.rng import round_key, sample_clients, seed_everything, server_key
 from fedml_tpu.core.tasks import get_task
 from fedml_tpu.data import FedDataset
 from fedml_tpu.models import ModelBundle, create_model
@@ -178,7 +178,7 @@ class FedAvgAPI:
         weighted train loss (shared by the single- and multi-group round
         programs)."""
         new_vars, new_state = self.aggregate(
-            variables, res.variables, counts, res, rng, server_state
+            variables, res.variables, counts, res, server_key(rng), server_state
         )
         # elastic rounds: failed clients enter with count 0 and drop out of
         # the weighted mean; an all-failed round is a full no-op — weights
@@ -499,6 +499,13 @@ class FedAvgAPI:
         with profile_trace(c.profile_dir):
             self._train_rounds(start_round, timer, logger)
         timing = timer.summary()
+        if c.async_rounds:
+            # run_round returned un-synced device scalars, so the 'train'
+            # phase timed DISPATCH only; only eval rounds (float(loss)) and
+            # the final eval actually blocked. Wall-clock — and
+            # rounds_per_sec, which divides by it — still ends on a real
+            # sync, so those stay honest.
+            timing["time/train_is_dispatch_only"] = True
         self.history["rounds_per_sec"] = timing["rounds_per_sec"]
         self.history["timing"] = timing
         self.metrics_logger = logger

@@ -153,10 +153,13 @@ class FedNASAPI:
                         # differentiates through the inner update EXACTLY.
                         # Each batch is split 50/50 into train/val halves —
                         # the static-shape form of the reference's separate
-                        # train/valid queues.
-                        half = bs // 2
-                        bxt, byt, bmt = bx[:half], by[:half], bm[:half]
-                        bxv, byv, bmv = bx[half:], by[half:], bm[half:]
+                        # train/valid queues. INTERLEAVED (even/odd slots),
+                        # not contiguous: the epoch order sorts real samples
+                        # to the front, so a contiguous split would leave the
+                        # tail partial batch's val half all-padding and those
+                        # architect steps with zero validation signal.
+                        bxt, byt, bmt = bx[0::2], by[0::2], bm[0::2]
+                        bxv, byv, bmv = bx[1::2], by[1::2], bm[1::2]
                         rho, wd_w = W_MOMENTUM, W_WEIGHT_DECAY
                         trace = optax.tree_utils.tree_get(wopt, "trace")
 

@@ -78,9 +78,11 @@ class FedAvgRobustAPI(FedAvgAPI):
     def crosssilo_hooks(self):
         """Mesh-path split of robust_aggregate: the norm-difference clip is
         per-client (pre-psum, on each silo's device); the weak-DP gaussian
-        noise is added to the replicated aggregate post-psum with the SAME
-        round key on every device, so the result is identical to the
-        reference's rank-0 defense (FedAvgRobustAggregator.py:14-60)."""
+        noise is added to the replicated aggregate post-psum with the same
+        server key (``rng.server_key`` of the round key) on every device, so
+        the result is identical to the reference's rank-0 defense
+        (FedAvgRobustAggregator.py:14-60) and to the simulation paradigm's
+        aggregate()."""
         c = self.config
         norm_bound, stddev = c.norm_bound, c.stddev
 

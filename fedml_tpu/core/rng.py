@@ -31,6 +31,15 @@ def round_key(root: jax.Array, round_idx: int) -> jax.Array:
     return jax.random.fold_in(root, round_idx)
 
 
+def server_key(round_k: jax.Array) -> jax.Array:
+    """Key for server-side randomness in a round (DP noise in robust
+    aggregation). Derived by fold_in rather than reusing the round key the
+    client keys were already split from (JAX RNG hygiene: never consume a
+    parent key after splitting it). The simulation and cross-silo paths both
+    use this same derivation so they stay bit-identical."""
+    return jax.random.fold_in(round_k, 0x5E87)
+
+
 def client_keys(round_k: jax.Array, num_clients: int) -> jax.Array:
     """[num_clients] keys for per-client dropout/shuffle inside one round."""
     return jax.random.split(round_k, num_clients)

@@ -107,7 +107,12 @@ class TAEdgeServerManager(ServerManager):
             self.send_message(m)
 
     def _on_total(self, msg: Message):
-        assert int(msg.get(KEY_ROUND)) == self.round_idx
+        # wire-protocol invariant: never an assert (stripped under -O, which
+        # would turn a misrouted total into silent weight corruption)
+        if int(msg.get(KEY_ROUND)) != self.round_idx:
+            raise RuntimeError(
+                f"TurboAggregate total for round {msg.get(KEY_ROUND)} arrived "
+                f"at server in round {self.round_idx}")
         field_total = np.asarray(msg.get(KEY_FIELD), np.int64)
         flat = dequantize(field_total, self.frac_bits, self.p)
         out, off = [], 0
@@ -247,7 +252,9 @@ class TAEdgeClientManager(ClientManager):
             self.send_message(out)
 
     def _on_partial(self, msg: Message):
-        assert self.is_leader
+        if not self.is_leader:
+            raise RuntimeError(
+                f"rank {self.rank}: partial-sum message routed to a non-leader")
         if self._ahead_of_round(msg, self._on_partial):
             return
         part = np.asarray(msg.get(KEY_FIELD), np.int64)
@@ -259,7 +266,9 @@ class TAEdgeClientManager(ClientManager):
         self._maybe_relay()
 
     def _on_relay(self, msg: Message):
-        assert self.is_leader
+        if not self.is_leader:
+            raise RuntimeError(
+                f"rank {self.rank}: relay message routed to a non-leader")
         if self._ahead_of_round(msg, self._on_relay):
             return
         self._relay_in = np.asarray(msg.get(KEY_FIELD), np.int64)

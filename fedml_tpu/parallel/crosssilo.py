@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from fedml_tpu.core.rng import server_key
 from fedml_tpu.parallel.local import LocalResult
 
 
@@ -99,7 +100,7 @@ def make_crosssilo_round(
         loss = jax.lax.psum(jnp.sum(res.train_loss * w), axis) / denom
         if server_update is not None:
             new_vars, new_state = server_update(
-                variables0, agg, extras, total, server_state, rng
+                variables0, agg, extras, total, server_state, server_key(rng)
             )
         else:
             new_vars, new_state = agg, server_state
