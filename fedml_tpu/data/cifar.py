@@ -23,6 +23,9 @@ from fedml_tpu.core.partition import partition as partition_fn
 
 _CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 _CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+# reference cinic10/data_loader.py:118-119
+_CINIC_MEAN = np.array([0.47889522, 0.47227842, 0.43047404], np.float32)
+_CINIC_STD = np.array([0.24205776, 0.23828046, 0.25874835], np.float32)
 
 
 def _load_cifar10_files(root: str):
@@ -57,14 +60,66 @@ def _load_cifar100_files(root: str):
     return x, y, tx, ty
 
 
-def _normalize(u8: np.ndarray) -> np.ndarray:
-    return ((u8.astype(np.float32) / 255.0) - _CIFAR_MEAN) / _CIFAR_STD
+def _load_cinic10_files(root: str):
+    """CINIC-10 ships as an ImageFolder tree (train/<class>/*.png,
+    test/<class>/*.png — reference cinic10/data_loader.py:114-147). Class
+    index = alphabetical class-dir order, matching torchvision ImageFolder."""
+    train_dir, test_dir = os.path.join(root, "train"), os.path.join(root, "test")
+    if not (os.path.isdir(train_dir) and os.path.isdir(test_dir)):
+        return None
+    # decoded-array cache: the real tree is ~180k PNGs; one sequential PIL
+    # pass costs minutes, so persist the decoded arrays next to the tree and
+    # load them in one read on every later run
+    cache = os.path.join(root, "cinic10_decoded.npz")
+    if os.path.isfile(cache):
+        z = np.load(cache)
+        return z["x"], z["y"], z["tx"], z["ty"]
+    from PIL import Image
+
+    def class_dirs(d):
+        return sorted(e for e in os.listdir(d)
+                      if os.path.isdir(os.path.join(d, e)))
+
+    # class index comes from the per-split alphabetical dir order; a split
+    # missing a class dir would silently shift every later index, so a
+    # mismatched tree must be an error, not garbage labels
+    classes = class_dirs(train_dir)
+    if classes != class_dirs(test_dir):
+        raise ValueError(
+            f"CINIC-10 train/test class dirs differ under {root}: "
+            f"{classes} vs {class_dirs(test_dir)}")
+
+    def load_split(d):
+        xs, ys = [], []
+        for ci, cls in enumerate(classes):
+            cdir = os.path.join(d, cls)
+            for fn in sorted(os.listdir(cdir)):
+                if not fn.lower().endswith((".png", ".jpg", ".jpeg")):
+                    continue
+                with Image.open(os.path.join(cdir, fn)) as im:
+                    xs.append(np.asarray(im.convert("RGB"), np.uint8))
+                ys.append(ci)
+        if not xs:
+            raise ValueError(f"CINIC-10 split {d} contains no images")
+        return np.stack(xs), np.asarray(ys)
+
+    x, y = load_split(train_dir)
+    tx, ty = load_split(test_dir)
+    try:
+        np.savez_compressed(cache, x=x, y=y, tx=tx, ty=ty)
+    except OSError:  # read-only data dir: just skip the cache
+        pass
+    return x, y, tx, ty
+
+
+def _normalize(u8: np.ndarray, mean=_CIFAR_MEAN, std=_CIFAR_STD) -> np.ndarray:
+    return ((u8.astype(np.float32) / 255.0) - mean) / std
 
 
 def _build(
     name: str, loaded, classes: int, client_num_in_total: int,
     partition_method: str, partition_alpha: float, batch_size: int, seed: int,
-    data_dir: str = "./data",
+    data_dir: str = "./data", mean=_CIFAR_MEAN, std=_CIFAR_STD,
 ) -> FedDataset:
     if loaded is None:
         return make_synthetic_classification(
@@ -74,9 +129,7 @@ def _build(
             data_dir=data_dir,
         )
     x, y, test_x, test_y = loaded
-    x, test_x = _normalize(x), _normalize(test_x)
-    import os
-
+    x, test_x = _normalize(x, mean, std), _normalize(test_x, mean, std)
     idx_map = partition_fn(
         partition_method, y, client_num_in_total, classes, partition_alpha,
         seed=seed,
@@ -125,7 +178,10 @@ def load_cinic10(
     partition_method: str = "hetero", partition_alpha: float = 0.5,
     batch_size: int = 64, seed: int = 0, **_,
 ) -> FedDataset:
-    # CINIC-10 ships as an image folder tree; without it we use the synthetic
-    # stand-in (same 10 classes / 32x32x3).
-    return _build("cinic10", None, 10, client_num_in_total,
-                  partition_method, partition_alpha, batch_size, seed, data_dir)
+    # CINIC-10 ships as an ImageFolder tree; without it we use the synthetic
+    # stand-in (same 10 classes / 32x32x3). Real files use CINIC's own
+    # per-channel statistics (reference data_loader.py:118-119).
+    return _build("cinic10", _load_cinic10_files(data_dir), 10,
+                  client_num_in_total, partition_method, partition_alpha,
+                  batch_size, seed, data_dir,
+                  mean=_CINIC_MEAN, std=_CINIC_STD)
