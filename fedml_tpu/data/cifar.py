@@ -67,35 +67,52 @@ def _load_cinic10_files(root: str):
     train_dir, test_dir = os.path.join(root, "train"), os.path.join(root, "test")
     if not (os.path.isdir(train_dir) and os.path.isdir(test_dir)):
         return None
-    # decoded-array cache: the real tree is ~180k PNGs; one sequential PIL
-    # pass costs minutes, so persist the decoded arrays next to the tree and
-    # load them in one read on every later run
-    cache = os.path.join(root, "cinic10_decoded.npz")
-    if os.path.isfile(cache):
-        z = np.load(cache)
-        return z["x"], z["y"], z["tx"], z["ty"]
     from PIL import Image
 
     def class_dirs(d):
         return sorted(e for e in os.listdir(d)
                       if os.path.isdir(os.path.join(d, e)))
 
+    def image_files(cdir):
+        return [fn for fn in sorted(os.listdir(cdir))
+                if fn.lower().endswith((".png", ".jpg", ".jpeg"))]
+
     # class index comes from the per-split alphabetical dir order; a split
-    # missing a class dir would silently shift every later index, so a
-    # mismatched tree must be an error, not garbage labels
+    # missing a class dir — or a stray extracted artifact like __MACOSX
+    # sorting in front and shifting every real class — must be an error,
+    # not garbage labels
     classes = class_dirs(train_dir)
     if classes != class_dirs(test_dir):
         raise ValueError(
             f"CINIC-10 train/test class dirs differ under {root}: "
             f"{classes} vs {class_dirs(test_dir)}")
+    if len(classes) != 10:
+        raise ValueError(
+            f"CINIC-10 tree under {root} has {len(classes)} class dirs "
+            f"({classes}); expected exactly 10")
+
+    # decoded-array cache: the real tree is ~180k PNGs; one sequential PIL
+    # pass costs minutes, so persist the decoded arrays next to the tree.
+    # Fingerprint = per-class image counts of both splits, so completing or
+    # fixing a partial download invalidates the cache instead of being
+    # silently ignored.
+    fingerprint = np.asarray(
+        [len(image_files(os.path.join(d, c)))
+         for d in (train_dir, test_dir) for c in classes], np.int64)
+    cache = os.path.join(root, "cinic10_decoded.npz")
+    if os.path.isfile(cache):
+        try:
+            z = np.load(cache)
+            if np.array_equal(z["fingerprint"], fingerprint):
+                return z["x"], z["y"], z["tx"], z["ty"]
+        except Exception:  # truncated/stale cache: fall through and rebuild
+            pass
 
     def load_split(d):
         xs, ys = [], []
         for ci, cls in enumerate(classes):
             cdir = os.path.join(d, cls)
-            for fn in sorted(os.listdir(cdir)):
-                if not fn.lower().endswith((".png", ".jpg", ".jpeg")):
-                    continue
+            for fn in image_files(cdir):
                 with Image.open(os.path.join(cdir, fn)) as im:
                     xs.append(np.asarray(im.convert("RGB"), np.uint8))
                 ys.append(ci)
@@ -106,9 +123,18 @@ def _load_cinic10_files(root: str):
     x, y = load_split(train_dir)
     tx, ty = load_split(test_dir)
     try:
-        np.savez_compressed(cache, x=x, y=y, tx=tx, ty=ty)
-    except OSError:  # read-only data dir: just skip the cache
-        pass
+        # atomic publish: a kill mid-write must not leave a truncated npz
+        # that bricks every later load
+        np.savez_compressed(cache + ".tmp.npz", x=x, y=y, tx=tx, ty=ty,
+                            fingerprint=fingerprint)
+        os.replace(cache + ".tmp.npz", cache)
+    except OSError:  # read-only data dir / disk full: just skip the cache
+        for p in (cache + ".tmp.npz",):
+            if os.path.exists(p):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
     return x, y, tx, ty
 
 

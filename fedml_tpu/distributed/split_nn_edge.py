@@ -292,11 +292,12 @@ class SplitNNEdgeClientManager(ClientManager):
 
 
 def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
-                     wire_roundtrip: bool = True):
+                     wire_roundtrip: bool = True, comm_factory=None):
     """In-process launch of server + one manager per client over the local
-    transport. Each client takes ``config.epochs`` epochs per turn and the
-    ring runs one full cycle (turns=1), mirroring the reference defaults.
-    Returns the server trainer (val_history, final variables)."""
+    transport (or a real one — e.g. gRPC loopback — via ``comm_factory``).
+    Each client takes ``config.epochs`` epochs per turn and the ring runs
+    one full cycle (turns=1), mirroring the reference defaults. Returns the
+    server trainer (val_history, final variables)."""
     from fedml_tpu.core.rng import seed_everything
 
     task = get_task(dataset.task, dataset.class_num)
@@ -337,5 +338,6 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
         return SplitNNEdgeClientManager(Args(), comm, rank, size, trainer,
                                         epochs_per_turn=config.epochs, turns=1)
 
-    run_ranks(make, size, wire_roundtrip=wire_roundtrip)
+    run_ranks(make, size, wire_roundtrip=wire_roundtrip,
+              comm_factory=comm_factory)
     return server_trainer

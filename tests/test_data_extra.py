@@ -354,3 +354,33 @@ def test_cinic10_real_imagefolder_parse(tmp_path):
 
     wrong = (got * _CIFAR_STD + _CIFAR_MEAN) * 255.0
     assert np.abs(wrong - np.round(wrong)).max() > 0.05
+
+
+def test_cinic10_decoded_cache_roundtrip_and_invalidation(tmp_path):
+    """The decoded-npz cache must return identical arrays on a warm load and
+    rebuild itself when the image tree changes (completed download)."""
+    from PIL import Image
+
+    from fedml_tpu.data.cifar import _load_cinic10_files
+
+    rng = np.random.default_rng(3)
+    classes = ["airplane", "automobile", "bird", "cat", "deer",
+               "dog", "frog", "horse", "ship", "truck"]
+    for split in ("train", "test"):
+        for cls in classes:
+            cdir = tmp_path / split / cls
+            cdir.mkdir(parents=True)
+            Image.fromarray(rng.integers(0, 256, (32, 32, 3), np.uint8)).save(
+                cdir / "a.png")
+
+    cold = _load_cinic10_files(str(tmp_path))
+    assert (tmp_path / "cinic10_decoded.npz").is_file()
+    warm = _load_cinic10_files(str(tmp_path))
+    for a, b in zip(cold, warm):
+        np.testing.assert_array_equal(a, b)
+
+    # grow one class dir -> fingerprint mismatch -> rebuild, not stale cache
+    Image.fromarray(rng.integers(0, 256, (32, 32, 3), np.uint8)).save(
+        tmp_path / "train" / "bird" / "b.png")
+    grown = _load_cinic10_files(str(tmp_path))
+    assert grown[0].shape[0] == cold[0].shape[0] + 1
