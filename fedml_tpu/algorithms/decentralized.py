@@ -104,8 +104,15 @@ class DecentralizedFedAPI(FedAvgAPI):
             self.node_vars, self.ps_weights, cx, cy, cm,
             jnp.asarray(counts, jnp.float32), rk,
         )
-        # consensus estimate for global eval (de-biased under pushsum)
-        debias = self.ps_weights if self.mode == "pushsum" else jnp.ones_like(self.ps_weights)
+        self._update_consensus()
+        return float(loss)
+
+    def _update_consensus(self):
+        """Refresh self.variables = node average (de-biased under pushsum) —
+        the consensus estimate global eval runs on. Shared by the simulator
+        and mesh forms so the eval semantics cannot drift apart."""
+        debias = (self.ps_weights if self.mode == "pushsum"
+                  else jnp.ones_like(self.ps_weights))
         self.variables = jax.tree.map(
             lambda x: jnp.mean(
                 x.astype(jnp.float32) / debias.reshape((-1,) + (1,) * (x.ndim - 1)),
@@ -113,7 +120,6 @@ class DecentralizedFedAPI(FedAvgAPI):
             ).astype(x.dtype),
             self.node_vars,
         )
-        return float(loss)
 
     def consensus_distance(self) -> float:
         """Mean squared distance of node models from their average — the
@@ -132,3 +138,59 @@ class DecentralizedFedAPI(FedAvgAPI):
         node = jax.tree.map(lambda x: x[node_idx], self.node_vars)
         sums = self._eval(node, self.dataset.test_x, self.dataset.test_y, self.dataset.test_mask)
         return finalize_metrics(jax.tree.map(np.asarray, sums))
+
+
+class MeshDecentralizedFedAPI(DecentralizedFedAPI):
+    """Gossip with nodes sharded over a device Mesh — the distributed form
+    of DSGD/PushSum (reference decentralized_worker_manager.py:41-46 runs it
+    as per-neighbor MPI sends). Node state, data, and the mixing matrix
+    columns live sharded in each device's HBM; the mix is a masked
+    partial-sum all-reduce (see parallel/gossip.py). Math is identical to
+    the einsum simulator up to psum reduction order.
+
+    ``num_clients`` must be a multiple of the mesh's node-axis size.
+    """
+
+    def __init__(self, dataset: FedDataset, config: FedConfig,
+                 bundle: Optional[ModelBundle] = None,
+                 topology: Optional[SymmetricTopologyManager] = None,
+                 mode: str = "dsgd", mesh=None):
+        from fedml_tpu.parallel.mesh import client_mesh
+
+        self.mesh = mesh or client_mesh(axis="nodes")
+        n_axis = dict(zip(self.mesh.axis_names,
+                          self.mesh.devices.shape))["nodes"]
+        if dataset.num_clients % n_axis:
+            raise ValueError(
+                f"num_clients ({dataset.num_clients}) must be a multiple of "
+                f"the mesh 'nodes' axis ({n_axis})")
+        super().__init__(dataset, config, bundle, topology, mode)
+        self._placed = None  # sharded (W, node_vars, ps, data) after round 0
+
+    def build_round_step(self):
+        from fedml_tpu.parallel.gossip import make_gossip_round
+
+        return make_gossip_round(self._local_train, self.mesh,
+                                 pushsum=self.mode == "pushsum")
+
+    def run_round(self, round_idx: int) -> float:
+        from fedml_tpu.core.rng import round_key
+        from fedml_tpu.parallel.gossip import place_gossip_inputs
+
+        if self._placed is None:
+            cx, cy, cm, counts = self.dataset.client_slice(
+                np.arange(self.dataset.num_clients))
+            W, self.node_vars, self.ps_weights, data = place_gossip_inputs(
+                self.mesh, self.W, self.node_vars, self.ps_weights,
+                (cx, cy, cm, jnp.asarray(counts, jnp.float32)))
+            self._placed = (W, data)
+        W, (cx, cy, cm, counts) = self._placed
+        rk = round_key(self.root_key, round_idx)
+        keys = jax.device_put(
+            jax.random.split(rk, self.dataset.num_clients),
+            jax.sharding.NamedSharding(self.mesh,
+                                       jax.sharding.PartitionSpec("nodes")))
+        self.node_vars, self.ps_weights, loss = self._round_step(
+            self.node_vars, self.ps_weights, W, cx, cy, cm, counts, keys)
+        self._update_consensus()
+        return float(loss)

@@ -26,7 +26,7 @@ ALGORITHMS = (
     "fedgkt", "fednas", "fedseg", "splitnn", "vfl", "centralized",
     "silo_fedavg", "silo_fedopt", "silo_fednova", "silo_fedagc",
     "crosssilo_fedopt", "crosssilo_fednova", "crosssilo_fedagc",
-    "crosssilo_fedavg_robust", "crosssilo_fedprox",
+    "crosssilo_fedavg_robust", "crosssilo_fedprox", "crosssilo_decentralized",
 )
 
 
@@ -135,7 +135,9 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
         return SplitNNAPI(ds, config, cb, sb).train()
 
     from fedml_tpu.algorithms.centralized import CentralizedTrainer
-    from fedml_tpu.algorithms.decentralized import DecentralizedFedAPI
+    from fedml_tpu.algorithms.decentralized import (
+        DecentralizedFedAPI, MeshDecentralizedFedAPI,
+    )
     from fedml_tpu.algorithms.fedagc import CrossSiloFedAGCAPI, FedAGCAPI
     from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
     from fedml_tpu.algorithms.fednova import CrossSiloFedNovaAPI, FedNovaAPI
@@ -162,6 +164,7 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
         "fedavg_robust": FedAvgRobustAPI,
         "hierarchical": HierarchicalFedAvgAPI,
         "decentralized": DecentralizedFedAPI,
+        "crosssilo_decentralized": MeshDecentralizedFedAPI,
         "turboaggregate": TurboAggregateAPI,
         "fedseg": FedSegAPI,
         "centralized": CentralizedTrainer,

@@ -1,0 +1,100 @@
+"""Multi-device gossip: DSGD/PushSum with nodes sharded over a mesh.
+
+The simulation form (algorithms/decentralized.py) mixes the stacked node
+models with one einsum on a single device. This is its mesh counterpart —
+the distributed deployment the reference runs as per-neighbor MPI sends
+(fedml_api/distributed/decentralized_framework/
+decentralized_worker_manager.py:41-46): each device holds N/D nodes, trains
+them under vmap, and the gossip mix runs as a masked partial-sum all-reduce.
+
+TPU-first design note: the mixing matrix W of a realistic topology (ring +
+Watts-Strogatz shortcuts) is SPARSE but irregular; rather than translate
+per-edge sends into point-to-point ppermutes (one hop per edge, poor ICI
+utilization for irregular graphs), every device computes its nodes'
+weighted contribution to ALL nodes — an [N, n_local] x [n_local, model]
+einsum on the MXU — and one psum over the node axis completes
+``new_i = sum_j W[i,j] x_j`` exactly. One collective per round, identical
+math to the einsum simulator (same f32 accumulation, psum adds only a
+reduction-order difference), and the all-reduce rides ICI at full
+bandwidth instead of serializing per-edge hops.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from fedml_tpu.parallel.local import LocalResult
+
+
+def make_gossip_round(
+    local_train: Callable,
+    mesh: Mesh,
+    axis: str = "nodes",
+    pushsum: bool = False,
+):
+    """Build the jitted sharded gossip round.
+
+    Returns ``round_fn(node_vars, ps_weights, W, cx, cy, cm, counts, keys)
+    -> (node_vars, ps_weights, loss)`` where ``node_vars`` / ``cx`` / ... are
+    stacked over the node axis (leading dim N divisible by the mesh size),
+    ``W`` is the [N, N] mixing matrix (column-stochastic for pushsum,
+    matching DecentralizedFedAPI), and ``ps_weights`` is the [N] PushSum
+    mass vector (ignored for plain DSGD but threaded for API parity).
+    """
+
+    def shard_fn(node_vars, ps_weights, W, cx, cy, cm, counts, keys):
+        # shards arrive [n_local, ...]; W arrives column-sharded [N, n_local]
+        n_local = cx.shape[0]
+        start = jax.lax.axis_index(axis) * n_local
+        res: LocalResult = jax.vmap(local_train)(
+            node_vars, cx, cy, cm, counts, keys
+        )
+
+        def mix_leaf(x):
+            # this device's nodes' contribution to EVERY node, then one
+            # all-reduce completes the mix; slice back out our own rows
+            part = jnp.einsum("ij,j...->i...", W, x.astype(jnp.float32))
+            full = jax.lax.psum(part, axis)
+            return jax.lax.dynamic_slice_in_dim(
+                full, start, n_local, axis=0).astype(x.dtype)
+
+        mixed = jax.tree.map(mix_leaf, res.variables)
+        if pushsum:
+            full_w = jax.lax.psum(W @ ps_weights, axis)
+            new_ps = jax.lax.dynamic_slice_in_dim(full_w, start, n_local, 0)
+        else:
+            new_ps = ps_weights
+        w = counts.astype(jnp.float32)
+        loss = (jax.lax.psum(jnp.sum(res.train_loss * w), axis)
+                / jnp.maximum(jax.lax.psum(jnp.sum(w), axis), 1e-12))
+        return mixed, new_ps, loss
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(None, axis),
+                  P(axis), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P()),
+    )
+    return jax.jit(mapped)
+
+
+def place_gossip_inputs(mesh: Mesh, W, node_vars, ps_weights, arrays,
+                        axis: str = "nodes"):
+    """Shard the node-stacked state over the mesh: W by columns, everything
+    else by its leading node axis."""
+    from jax.sharding import NamedSharding
+
+    node_sh = NamedSharding(mesh, P(axis))
+    col_sh = NamedSharding(mesh, P(None, axis))
+    return (
+        jax.device_put(W, col_sh),
+        jax.device_put(node_vars, node_sh),
+        jax.device_put(ps_weights, node_sh),
+        tuple(jax.device_put(a, node_sh) for a in arrays),
+    )

@@ -75,7 +75,8 @@ def test_dispatcher_covers_remaining_standalone_algorithms(algo):
 
 @pytest.mark.parametrize("algo", ["crosssilo_fedavg", "crosssilo_fedopt",
                                   "crosssilo_fednova", "crosssilo_fedagc",
-                                  "crosssilo_fedavg_robust", "crosssilo_fedprox"])
+                                  "crosssilo_fedavg_robust", "crosssilo_fedprox",
+                                  "crosssilo_decentralized"])
 def test_dispatcher_covers_crosssilo(algo):
     # 8 virtual devices; full participation, cohort == mesh size
     out = main(_argv(algo, client_num_in_total="8",
@@ -113,7 +114,8 @@ def test_dispatcher_covers_fednas_and_fedseg_and_nothing_is_missed():
         # dedicated launcher tests in this file
         "vfl", "fedgkt", "crosssilo_fedavg", "crosssilo_fedopt",
         "crosssilo_fednova", "crosssilo_fedagc", "crosssilo_fedavg_robust",
-        "crosssilo_fedprox", "splitnn", "fednas", "fedseg",
+        "crosssilo_fedprox", "crosssilo_decentralized", "splitnn", "fednas",
+        "fedseg",
         # remaining-standalone parametrize
         "fedagc", "fedavg_robust", "hierarchical", "decentralized",
         "silo_fedavg", "silo_fedopt", "silo_fednova", "silo_fedagc",
