@@ -159,7 +159,10 @@ class MeshDecentralizedFedAPI(DecentralizedFedAPI):
 
         self.mesh = mesh or client_mesh(axis="nodes")
         n_axis = dict(zip(self.mesh.axis_names,
-                          self.mesh.devices.shape))["nodes"]
+                          self.mesh.devices.shape)).get("nodes")
+        if n_axis is None:
+            raise ValueError(
+                f"mesh must have a 'nodes' axis, got {self.mesh.axis_names}")
         if dataset.num_clients % n_axis:
             raise ValueError(
                 f"num_clients ({dataset.num_clients}) must be a multiple of "

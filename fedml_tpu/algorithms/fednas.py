@@ -101,7 +101,10 @@ class FedNASAPI:
         self.genotypes: list = []
         self.history: list[dict] = []
 
-    def _build_search_round(self):
+    def _build_local_search(self):
+        """One client's full local search (alternating alpha/weight steps
+        over epochs of minibatches) as a pure function — vmapped by the
+        simulator's round, shard_mapped by the cross-silo round."""
         module, cfg = self.module, self.config
         wtx, atx = self._wtx, self._atx
         bs = cfg.batch_size
@@ -238,6 +241,11 @@ class FedNASAPI:
             )
             return variables, alphas, ep_losses[-1]
 
+        return local_search
+
+    def _build_search_round(self):
+        local_search = self._build_local_search()
+
         @jax.jit
         def search_round(variables, alphas, cx, cy, cm, counts, rng):
             keys = jax.random.split(rng, cx.shape[0])
@@ -315,3 +323,82 @@ class FedNASAPI:
             genotype=g, channels=channels, layers=layers,
             output_dim=self.dataset.class_num,
         )
+
+
+class CrossSiloFedNASAPI(FedNASAPI):
+    """FedNAS on the cross-silo mesh path: silos sharded over a 'clients'
+    Mesh, each device searches its clients under vmap, and BOTH the weight
+    and alpha pytrees aggregate by weighted psum on ICI — the in-mesh
+    counterpart of the reference's rank-0 FedNASAggregator, which weighted-
+    averages weights AND alphas across MPI ranks
+    (distributed/fednas/FedNASAggregator.py:70-107 __aggregate +
+    __aggregate_alpha). Both reductions are plain weighted means, so they
+    ride one fused all-reduce; genotype derivation stays host-side on the
+    replicated result, identical to the simulator."""
+
+    def __init__(self, dataset, config, mesh=None, **kw):
+        from fedml_tpu.parallel.mesh import client_mesh
+
+        self.mesh = mesh or client_mesh()
+        axis_sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        n_axis = axis_sizes.get("clients")
+        if n_axis is None:
+            raise ValueError(
+                f"mesh must have a 'clients' axis, got {self.mesh.axis_names}")
+        # validate the cohort train() actually samples: population is capped
+        # by BOTH client_num_in_total and the dataset (see FedNASAPI.train)
+        population = min(config.client_num_in_total, dataset.num_clients)
+        cohort = min(config.client_num_per_round, population)
+        if cohort % n_axis:
+            raise ValueError(
+                f"effective cohort size ({cohort}) must be a multiple of the "
+                f"mesh 'clients' axis ({n_axis})")
+        super().__init__(dataset, config, **kw)
+
+    def _build_search_round(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax import shard_map
+
+        local_search = self._build_local_search()
+        mesh, axis = self.mesh, "clients"
+
+        def shard_fn(variables, alphas, cx, cy, cm, counts, keys):
+            from fedml_tpu.parallel.crosssilo import weighted_psum_tree_mean
+
+            new_vars, new_alphas, losses = jax.vmap(
+                local_search, in_axes=(None, None, 0, 0, 0, 0, 0)
+            )(variables, alphas, cx, cy, cm, counts, keys)
+            w = counts.astype(jnp.float32)
+            denom = jnp.maximum(jax.lax.psum(jnp.sum(w), axis), 1e-12)
+            agg_vars = weighted_psum_tree_mean(new_vars, w, axis, denom)
+            agg_alphas = weighted_psum_tree_mean(new_alphas, w, axis, denom)
+            loss = jax.lax.psum(jnp.sum(losses * w), axis) / denom
+            return agg_vars, agg_alphas, loss
+
+        # check_vma=False (like make_hierarchical_round): the architect's
+        # adam state carries replicated-initialized scalars (step count)
+        # through a scan over device-varying data, which the varying-axes
+        # checker rejects. Safe here because every psum runs AFTER local
+        # autodiff — no collective sits inside a differentiated region, so
+        # the psum-transpose hazard (see tests pinning SP/PP exactness)
+        # cannot arise.
+        mapped = jax.jit(shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        ))
+        rep = NamedSharding(mesh, P())
+        sharded = NamedSharding(mesh, P(axis))
+
+        def search_round(variables, alphas, cx, cy, cm, counts, rng):
+            # same key values as the simulator's in-jit split(rng, C)
+            keys = jax.random.split(rng, cx.shape[0])
+            variables, alphas = (jax.device_put(variables, rep),
+                                 jax.device_put(alphas, rep))
+            cx, cy, cm, counts, keys = (
+                jax.device_put(jnp.asarray(a), sharded)
+                for a in (cx, cy, cm, counts, keys))
+            return mapped(variables, alphas, cx, cy, cm, counts, keys)
+
+        return search_round

@@ -18,7 +18,7 @@ import logging
 import jax
 import numpy as np
 
-from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
 from fedml_tpu.core.tasks import segmentation_scores
 
 log = logging.getLogger(__name__)
@@ -38,3 +38,11 @@ class FedSegAPI(FedAvgAPI):
         scores["loss"] = 1.0 - scores["mIoU"]
         scores["confusion_total"] = float(np.sum(np.asarray(sums["confusion"])))
         return scores
+
+
+class CrossSiloFedSegAPI(CrossSiloFedAvgAPI, FedSegAPI):
+    """FedSeg on the cross-silo mesh path — the deployable counterpart of
+    the reference's distributed FedSeg (FedSegAggregator.py:12-190). Its
+    aggregation is the plain weighted mean, so the in-mesh psum round is
+    inherited unchanged from CrossSiloFedAvgAPI; FedSegAPI contributes the
+    confusion-matrix mIoU/FWIoU evaluation on the replicated result."""

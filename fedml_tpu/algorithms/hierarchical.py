@@ -69,3 +69,89 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             return new_vars, server_state, losses[-1]
 
         return round_step
+
+
+class CrossSiloHierarchicalFedAvgAPI(HierarchicalFedAvgAPI):
+    """Hierarchical FL on a 2-D ('group', 'clients') device mesh — the
+    deployable counterpart of the reference's process-tree hierarchical
+    deployment (hierarchical_fl/trainer.py:43-69 nested loops over group
+    processes). Group aggregation psums over the ICI-adjacent 'clients'
+    axis every group round; the global group-model reduce crosses the
+    'group' axis once per round (DCN on a real pod) — see
+    parallel/crosssilo.make_hierarchical_round, which this wraps.
+
+    Equivalence with the simulator is by construction: row g of the mesh
+    holds clients {j*G+g} (the simulator's round-robin gid = i % G) and
+    every client consumes the same per-round key the simulator's in-jit
+    split produces (mesh-verified in tests/test_crosssilo.py and the
+    dryrun portfolio).
+
+    The effective cohort (full participation is the standard hierarchical
+    deployment) must equal group_num x (a multiple of the mesh's clients
+    axis).
+    """
+
+    def __init__(self, dataset, config, bundle=None, mesh=None):
+        from fedml_tpu.parallel.mesh import hierarchical_mesh
+
+        group_num = max(int(config.group_num), 1)
+        if mesh is None:
+            n_dev = len(jax.devices())
+            if n_dev % group_num:
+                raise ValueError(
+                    f"group_num ({group_num}) must divide the device count "
+                    f"({n_dev}) to build the ('group','clients') mesh")
+            mesh = hierarchical_mesh(group_num, n_dev // group_num)
+        self.mesh = mesh
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if set(mesh.axis_names) != {"group", "clients"}:
+            raise ValueError(
+                f"mesh must have ('group','clients') axes, got {mesh.axis_names}")
+        cohort = min(config.client_num_per_round, dataset.num_clients)
+        cpg_dev = axis_sizes["clients"]
+        if group_num != axis_sizes["group"]:
+            raise ValueError(
+                f"config.group_num ({group_num}) != mesh 'group' axis "
+                f"({axis_sizes['group']})")
+        if cohort % group_num or (cohort // group_num) % cpg_dev:
+            raise ValueError(
+                f"effective cohort ({cohort}) must split into {group_num} "
+                f"groups of a multiple of {cpg_dev} clients")
+        super().__init__(dataset, config, bundle)
+
+    def build_round_step(self):
+        from fedml_tpu.parallel.crosssilo import make_hierarchical_round
+        from fedml_tpu.parallel.mesh import replicated
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        round_fn = make_hierarchical_round(
+            self._local_train, self.mesh, group_rounds=self.group_comm_round)
+        mesh, G, GR = self.mesh, self.group_num, self.group_comm_round
+        data_sh = NamedSharding(mesh, P("group", "clients"))
+        key_sh = NamedSharding(mesh, P(None, "group", "clients"))
+
+        def round_step(variables, server_state, cx, cy, cm, counts, rng):
+            C = cx.shape[0]
+            cpg = C // G
+            # row g holds clients {j*G+g} — the simulator's gid = i % G
+            order = np.array([[j * G + g for j in range(cpg)] for g in range(G)])
+            flat = order.ravel()
+
+            def regroup(a):
+                return jax.device_put(
+                    jnp.asarray(a)[flat].reshape((G, cpg) + a.shape[1:]), data_sh)
+
+            # per-client keys replicate the simulator's in-jit split exactly:
+            # group-round r key for client i = split(split(rng, GR)[r], C)[i]
+            gr_keys = jax.random.split(rng, GR)
+            keys = jnp.stack([
+                jax.random.split(k, C)[flat].reshape((G, cpg)) for k in gr_keys
+            ])
+            new_vars, loss = round_fn(
+                jax.device_put(variables, replicated(mesh)),
+                regroup(cx), regroup(cy), regroup(cm),
+                regroup(jnp.asarray(counts, jnp.float32)),
+                jax.device_put(keys, key_sh))
+            return new_vars, server_state, loss
+
+        return round_step

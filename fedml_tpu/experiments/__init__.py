@@ -27,6 +27,7 @@ ALGORITHMS = (
     "silo_fedavg", "silo_fedopt", "silo_fednova", "silo_fedagc",
     "crosssilo_fedopt", "crosssilo_fednova", "crosssilo_fedagc",
     "crosssilo_fedavg_robust", "crosssilo_fedprox", "crosssilo_decentralized",
+    "crosssilo_fedseg", "crosssilo_hierarchical", "crosssilo_fednas",
 )
 
 
@@ -118,12 +119,13 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
                         server_blocks_per_stage=blocks[1],
                         server_mesh=server_mesh)
         return api.train()
-    if algorithm == "fednas":
-        from fedml_tpu.algorithms.fednas import FedNASAPI
+    if algorithm in ("fednas", "crosssilo_fednas"):
+        from fedml_tpu.algorithms.fednas import CrossSiloFedNASAPI, FedNASAPI
 
         size = dict(channels=4, layers=2, steps=2, multiplier=2) if config.ci \
             else dict(channels=16, layers=8, steps=4, multiplier=4)
-        return FedNASAPI(ds, config, **size).train()
+        cls = CrossSiloFedNASAPI if algorithm == "crosssilo_fednas" else FedNASAPI
+        return cls(ds, config, **size).train()
     if algorithm == "splitnn":
         from fedml_tpu.algorithms.split_nn import SplitNNAPI
         from fedml_tpu.models.split import create_split_cnn, create_split_mlp
@@ -143,8 +145,10 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
     from fedml_tpu.algorithms.fednova import CrossSiloFedNovaAPI, FedNovaAPI
     from fedml_tpu.algorithms.fedopt import CrossSiloFedOptAPI, FedOptAPI
     from fedml_tpu.algorithms.fedprox import CrossSiloFedProxAPI, FedProxAPI
-    from fedml_tpu.algorithms.fedseg import FedSegAPI
-    from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
+    from fedml_tpu.algorithms.fedseg import CrossSiloFedSegAPI, FedSegAPI
+    from fedml_tpu.algorithms.hierarchical import (
+        CrossSiloHierarchicalFedAvgAPI, HierarchicalFedAvgAPI,
+    )
     from fedml_tpu.algorithms.robust import CrossSiloFedAvgRobustAPI, FedAvgRobustAPI
     from fedml_tpu.algorithms.silo import SiloRunner
     from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
@@ -163,10 +167,12 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
         "fedagc": FedAGCAPI,
         "fedavg_robust": FedAvgRobustAPI,
         "hierarchical": HierarchicalFedAvgAPI,
+        "crosssilo_hierarchical": CrossSiloHierarchicalFedAvgAPI,
         "decentralized": DecentralizedFedAPI,
         "crosssilo_decentralized": MeshDecentralizedFedAPI,
         "turboaggregate": TurboAggregateAPI,
         "fedseg": FedSegAPI,
+        "crosssilo_fedseg": CrossSiloFedSegAPI,
         "centralized": CentralizedTrainer,
     }
     bundle = _bundle_for(config, ds)

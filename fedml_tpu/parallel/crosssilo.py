@@ -28,6 +28,22 @@ from fedml_tpu.core.rng import server_key
 from fedml_tpu.parallel.local import LocalResult
 
 
+def weighted_psum_tree_mean(tree, w, axis, denom):
+    """The one weighted-mean-by-all-reduce used by every mesh aggregation:
+    per-leaf ``psum_over(axis)(sum_i w_i * x_i) / denom`` with f32
+    accumulation and a cast back to the leaf dtype. ``denom`` must already
+    be the psum'd total weight (epsilon-guarded by the caller) so callers
+    with different reduction scopes (global vs per-group) share this one
+    numerically sensitive body."""
+
+    def reduce_leaf(x):
+        wb = w.reshape((-1,) + (1,) * (x.ndim - 1))
+        s = jax.lax.psum(jnp.sum(x.astype(jnp.float32) * wb, axis=0), axis)
+        return (s / denom).astype(x.dtype)
+
+    return jax.tree.map(reduce_leaf, tree)
+
+
 def make_crosssilo_round(
     local_train: Callable,
     mesh: Mesh,
@@ -84,13 +100,7 @@ def make_crosssilo_round(
         w = counts.astype(jnp.float32)
         total = jax.lax.psum(jnp.sum(w), axis)
         denom = jnp.maximum(total, 1e-12)
-
-        def reduce_leaf(x):
-            wb = w.reshape((-1,) + (1,) * (x.ndim - 1))
-            s = jax.lax.psum(jnp.sum(x.astype(jnp.float32) * wb, axis=0), axis)
-            return (s / denom).astype(x.dtype)
-
-        agg = jax.tree.map(reduce_leaf, stacked)
+        agg = weighted_psum_tree_mean(stacked, w, axis, denom)
         extras = None
         if reduce_extras is not None:
             extras = jax.tree.map(
@@ -166,14 +176,8 @@ def make_hierarchical_round(
             res: LocalResult = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
                 gvars, cx, cy, cm, counts, keys_local
             )
-
-            def reduce_leaf(x):
-                wb = w.reshape((-1,) + (1,) * (x.ndim - 1))
-                s = jax.lax.psum(jnp.sum(x.astype(jnp.float32) * wb, axis=0),
-                                 client_axis)            # ICI only
-                return (s / gden).astype(x.dtype)
-
-            gvars = jax.tree.map(reduce_leaf, res.variables)
+            # reduce over the client axis only: ICI within the group
+            gvars = weighted_psum_tree_mean(res.variables, w, client_axis, gden)
             loss = jax.lax.psum(jnp.sum(res.train_loss * w), client_axis) / gden
             return gvars, loss
 
