@@ -152,7 +152,12 @@ class FedGKTAPI:
         )
 
     # --------------------------------------------------------- client phase
-    def _build_client_phase(self):
+    def _build_client_train_one(self):
+        """One client's distillation training + extraction pass as a pure
+        function — vmapped over the cohort by the simulation's client phase,
+        jitted standalone by the message-driven edge client
+        (distributed/fedgkt_edge.py), so both paradigms run the identical
+        per-client program."""
         pair, cfg = self.pair, self.config
         tx = self._ctx
         bs = cfg.batch_size
@@ -226,6 +231,11 @@ class FedGKTAPI:
             # extraction pass in eval mode (GKTClientTrainer.py:108-120)
             logits, feats = pair.client.apply_eval(cvars, x)
             return cvars, copt, feats, logits, ep_losses[-1]
+
+        return train_one
+
+    def _build_client_phase(self):
+        train_one = self._build_client_train_one()
 
         @jax.jit
         def client_phase(cvars_stacked, copt_stacked, x, y, mask, counts, slogits, kl_w, rng):

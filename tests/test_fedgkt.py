@@ -61,3 +61,60 @@ def test_fedgkt_end_to_end():
     # server logits aligned per sample: [C, n_pad, classes]
     assert api.server_logits.shape == (4, ds.train_x.shape[1], 3)
     assert len(api.history) == 3
+
+
+class TestGKTEdge:
+    """Message-driven FedGKT (VERDICT r2 #4): the feature/logit exchange
+    over comm/ must reproduce FedGKTAPI. The edge clients run the SAME
+    jitted train_one the simulation vmaps, so the only slack is
+    vmap(C)-vs-single-client numerics (BN reduction order)."""
+
+    def _run_pair(self, comm_factory=None):
+        from fedml_tpu.distributed.fedgkt_edge import run_fedgkt_edge
+
+        ds = _ds()
+        cfg = FedConfig(
+            model="lr", dataset="synthetic", client_num_in_total=4,
+            client_num_per_round=4, comm_round=2, epochs=1, epochs_server=1,
+            batch_size=4, lr=0.05, seed=5, frequency_of_the_test=1,
+        )
+        sim = FedGKTAPI(ds, cfg, client_blocks=1, server_blocks_per_stage=1)
+        sim_out = sim.train()
+        server = run_fedgkt_edge(ds, cfg, client_blocks=1,
+                                 server_blocks_per_stage=1,
+                                 comm_factory=comm_factory)
+        return sim, sim_out, server
+
+    def test_matches_simulation(self):
+        sim, sim_out, server = self._run_pair()
+        edge_out = server.history[-1]
+        assert edge_out["round"] == sim_out["round"]
+        # accuracy: allow at most ONE boundary sample to flip — vmap(C) vs
+        # per-client execution reduces BN statistics in a different order,
+        # and a test sample near the decision boundary may land differently
+        n_test = int(np.sum(sim._test_shards[2]))
+        np.testing.assert_allclose(edge_out["Test/Acc"], sim_out["Test/Acc"],
+                                   atol=1.0 / n_test + 1e-9)
+        np.testing.assert_allclose(edge_out["Test/Loss"], sim_out["Test/Loss"],
+                                   rtol=5e-3, atol=5e-4)
+        np.testing.assert_allclose(edge_out["Train/ServerLoss"],
+                                   sim_out["Train/ServerLoss"],
+                                   rtol=5e-3, atol=5e-4)
+        # the returned global logits (next round's distillation targets)
+        for a, b in zip(jax.tree.leaves(sim.server_logits),
+                        jax.tree.leaves(server.api.server_logits)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=5e-2, atol=5e-2)
+
+    def test_grpc_loopback(self):
+        import pytest
+
+        pytest.importorskip("grpc")
+        from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+        _, sim_out, server = self._run_pair(
+            comm_factory=lambda r: GRPCCommManager(rank=r, size=5,
+                                                   base_port=56900))
+        assert np.isfinite(server.history[-1]["Test/Loss"])
+        np.testing.assert_allclose(server.history[-1]["Test/Acc"],
+                                   sim_out["Test/Acc"], atol=0.051)
