@@ -17,6 +17,13 @@ headline hardware, 8xV100 (FedML paper, arXiv:2007.13518): 8 workers
 training ResNet-56/CIFAR-10 in parallel at ~1500 img/s/GPU fp32 = 12000
 img/s cluster-wide, ignoring its MPI state-dict exchange + 0.3 s/message
 poll overhead (com_manager.py:78) — i.e., a GENEROUS baseline.
+
+Measured complement (round 3): `tools/ref_bench.py` RUNS the reference's
+execution model (torch, sequential clients, per-batch Python loop) on this
+host's CPU next to fedml_tpu on the same CPU — measured numbers and the
+honest backend attribution live in docs/perf.md §"Measured reference-stack
+baseline". The 12k estimate stays as the vs_baseline divisor because the
+single-CPU measurement cannot be extrapolated to the 8xV100 cluster.
 """
 
 from __future__ import annotations
@@ -86,6 +93,61 @@ RECORDS_PER_CLIENT = 1562  # 50000/32
 BATCH_SIZE = 64
 EPOCHS = 1
 MEASURE_ROUNDS = 5
+
+
+def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
+    """Cross-silo distributed FedAvg on the same chip: full participation
+    over a 1-device 'clients' mesh, resident-sharded data, psum aggregation.
+    Reports its own real-images/sec so the mesh path's overhead vs the
+    simulation paradigm is a measured number, not an assumption."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    clients = 4 if tiny else NUM_CLIENTS
+    records = 8 if tiny else RECORDS_PER_CLIENT
+    ds = make_synthetic_classification(
+        "cifar10-bench-cs", (32, 32, 3), 10, clients,
+        records_per_client=records,
+        partition_method="homo" if tiny else "hetero",
+        partition_alpha=0.5, batch_size=batch, seed=0,
+    )
+    cfg = FedConfig(
+        model=model, dataset="cifar10", client_num_in_total=clients,
+        client_num_per_round=clients,     # full participation: silo standard
+        comm_round=rounds, batch_size=batch, epochs=EPOCHS, lr=0.1,
+        momentum=0.9, dtype="bfloat16", frequency_of_the_test=10_000,
+        seed=0, async_rounds=True,
+        # force residency even on the CPU smoke path so tiny mode exercises
+        # the same resident-sharded branch the TPU run measures
+        device_data="on",
+    )
+    bundle = create_model(model, 10, dtype=jnp.bfloat16,
+                          input_shape=ds.train_x.shape[2:])
+    api = CrossSiloFedAvgAPI(ds, cfg, bundle, mesh=client_mesh(1))
+    for r in range(1, rounds + 1):
+        last = api.run_round(r)
+    float(last)
+    t0 = time.perf_counter()
+    for r in range(1, rounds + 1):
+        last = api.run_round(r)
+    float(last)
+    dt = time.perf_counter() - t0
+    n_pad = int(ds.train_x.shape[1])
+    real = int(ds.train_counts.sum()) * EPOCHS * rounds
+    padded = n_pad * clients * EPOCHS * rounds
+    return {
+        "paradigm": "crosssilo shard_map psum, full participation, resident-sharded",
+        "clients": clients,
+        "images_per_sec": round(real / dt, 1),
+        "padded_images_per_sec": round(padded / dt, 1),
+        "rounds_per_sec": round(rounds / dt, 4),
+    }
 
 
 def main():
@@ -181,6 +243,14 @@ def main():
     mfu = (round(padded_images / dt * train_flops / peak, 4)
            if (train_flops and peak) else None)
 
+    # Cross-silo paradigm on the same hardware (VERDICT r2 #3): the north
+    # star names DISTRIBUTED FedAvg, so measure the shard_map mesh path too —
+    # full participation (the standard silo deployment), dataset resident and
+    # sharded over a 1-device 'clients' mesh, aggregation by weighted psum.
+    crosssilo = None
+    if not os.environ.get("BENCH_NO_CROSSSILO"):
+        crosssilo = _bench_crosssilo(tiny, model, rounds, batch)
+
     result = {
         "metric": f"fedavg_local_sgd_images_per_sec ({model}, CIFAR-10 shapes, 32 non-IID clients, 8/round, bf16)",
         "value": round(img_per_sec, 1),
@@ -190,6 +260,7 @@ def main():
         "padded_images_per_sec": round(padded_images / dt, 1),
         "model_flops_per_image": round(train_flops) if train_flops else None,
         "mfu": mfu,
+        "crosssilo": crosssilo,
         # mfu is an ESTIMATE: fwd FLOPs from XLA's cost model on the named
         # backend x3 for the train step, over the bf16 peak of the matched
         # spec-table entry — provenance recorded so a cost-model change or a

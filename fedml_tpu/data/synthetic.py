@@ -91,6 +91,7 @@ def make_synthetic_classification(
     integer_inputs: bool = False,
     vocab: int = 0,
     data_dir: str = "./data",
+    separation: float = 1.0,
 ) -> FedDataset:
     """Learnable stand-in with the same shapes/partition semantics as the real
     dataset (used when the files aren't on disk — this image has no egress).
@@ -109,7 +110,11 @@ def make_synthetic_classification(
         x = x.astype(np.int32)
     else:
         dim = int(np.prod(input_shape))
-        means = rng.normal(0, 1.0, (classes, dim))
+        # separation scales the class-mean spread relative to unit noise: in
+        # high dim the default blobs are many sigma apart (trivially
+        # separable), so convergence-pin tests shrink it to land mid-range
+        # accuracy where dtype/precision drift is actually visible
+        means = rng.normal(0, 1.0, (classes, dim)) * separation
         x = (means[y] + rng.normal(0, 1.0, (n_total, dim))).astype(dtype)
         x = x.reshape((n_total,) + tuple(input_shape))
     train_x, train_y = x[:-test_records], y[:-test_records]

@@ -25,12 +25,18 @@ class BasicBlock(nn.Module):
     strides: int = 1
     dtype: Any = jnp.float32
     bn_axis: Any = None  # mapped-axis name for cross-device sync-BN
+    use_norm: bool = True  # False: perf-experiment variant without BN
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
-                       dtype=self.dtype, axis_name=self.bn_axis)
+        if self.use_norm:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, dtype=self.dtype,
+                           axis_name=self.bn_axis)
+        else:
+            def norm():
+                return lambda y: y
         residual = x
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding="SAME")(x)
         y = nn.relu(norm()(y))
@@ -43,24 +49,33 @@ class BasicBlock(nn.Module):
 
 
 class CifarResNet(nn.Module):
-    """depth = 6n+2; blocks_per_stage = n."""
+    """depth = 6n+2; blocks_per_stage = n.
+
+    ``widths`` defaults to the standard 16/32/64; the perf-experiment
+    variants (docs/mfu_experiments.md) override it to isolate how MXU lane
+    utilization scales with channel count on TPU."""
 
     blocks_per_stage: int
     output_dim: int = 10
     dtype: Any = jnp.float32
     bn_axis: Any = None  # sync-BN over this mapped axis (batchnorm_utils.py counterpart)
+    widths: tuple = (16, 32, 64)
+    use_norm: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                                 dtype=self.dtype, axis_name=self.bn_axis)(x))
-        for stage, filters in enumerate((16, 32, 64)):
+        x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        if self.use_norm:
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=self.dtype, axis_name=self.bn_axis)(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate(self.widths):
             for block in range(self.blocks_per_stage):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = BasicBlock(filters, strides, dtype=self.dtype,
-                               bn_axis=self.bn_axis)(x, train=train)
+                               bn_axis=self.bn_axis,
+                               use_norm=self.use_norm)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
 
@@ -98,4 +113,39 @@ def _resnet20(output_dim: int, dtype=jnp.float32, bn_axis=None, **_):
         module=_make(20, output_dim, dtype, bn_axis),
         input_shape=(32, 32, 3),
         has_batch_stats=True,
+    )
+
+
+def _register_width_variant(name: str, widths: tuple):
+    """Perf-experiment variants (docs/mfu_experiments.md): same depth-56
+    topology with uniform channel widths, used to measure how flagship MFU
+    scales with MXU lane occupancy (Cout/128). Not part of the reference
+    zoo — benchmarking instruments, not training recipes."""
+
+    @register_model(name)
+    def _variant(output_dim: int, dtype=jnp.float32, bn_axis=None, **_):
+        return ModelBundle(
+            name=name,
+            module=CifarResNet(9, output_dim, dtype=dtype, bn_axis=bn_axis,
+                               widths=widths),
+            input_shape=(32, 32, 3),
+            has_batch_stats=True,
+        )
+    return _variant
+
+
+_register_width_variant("resnet56_w64", (64, 64, 64))
+_register_width_variant("resnet56_w128", (128, 128, 128))
+
+
+@register_model("resnet56_nonorm")
+def _resnet56_nonorm(output_dim: int, dtype=jnp.float32, **_):
+    """Perf-experiment variant: standard widths, NO BatchNorm anywhere —
+    isolates normalization's share of the flagship step time (BN is a
+    spatial reduction XLA cannot fuse into the convs)."""
+    return ModelBundle(
+        name="resnet56_nonorm",
+        module=CifarResNet(9, output_dim, dtype=dtype, use_norm=False),
+        input_shape=(32, 32, 3),
+        has_batch_stats=False,
     )

@@ -1,0 +1,32 @@
+"""bf16 convergence pin at flagship shapes (VERDICT r2 weak #5): ResNet-20,
+50 FedAvg rounds on CIFAR-shaped synthetic data — bf16 end-to-end training
+must land within 1 accuracy point of the f32 run. Gated behind RUN_SLOW=1:
+on the 1-CPU test host this is ~2x50 rounds of real conv training (tens of
+minutes); the same pin runs on the real chip via `python tools/bf16_pin.py`
+and its measured result is recorded in docs/perf.md.
+"""
+
+import os
+
+import pytest
+
+
+@pytest.mark.skipif(not os.environ.get("RUN_SLOW"),
+                    reason="slow: 2x50 federated ResNet-20 rounds; set RUN_SLOW=1")
+def test_bf16_matches_f32_at_flagship_shapes():
+    from tools.bf16_pin import run_pin
+
+    import numpy as np
+
+    out = run_pin()
+    # end-of-run window (last 3 evals) smooths single-eval noise
+    f32 = float(np.mean(out["float32"]["acc_curve"][-3:]))
+    bf16 = float(np.mean(out["bfloat16"]["acc_curve"][-3:]))
+    # both runs must actually learn (10 classes, chance = 0.1)
+    assert f32 > 0.3, out
+    assert bf16 > 0.3, out
+    # accuracy-parity clause of the north star (BASELINE.md): bf16 must not
+    # DEGRADE accuracy by more than 1 point. One-sided: bf16 landing above
+    # f32 (observed on-chip: 0.848 vs 0.820) is run-to-run noise, not a
+    # failure mode this pin guards against.
+    assert bf16 >= f32 - 0.01 - 1e-9, out

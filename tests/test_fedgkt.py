@@ -69,6 +69,8 @@ class TestGKTEdge:
     jitted train_one the simulation vmaps, so the only slack is
     vmap(C)-vs-single-client numerics (BN reduction order)."""
 
+    _sim_cache = {}
+
     def _run_pair(self, comm_factory=None):
         from fedml_tpu.distributed.fedgkt_edge import run_fedgkt_edge
 
@@ -78,8 +80,11 @@ class TestGKTEdge:
             client_num_per_round=4, comm_round=2, epochs=1, epochs_server=1,
             batch_size=4, lr=0.05, seed=5, frequency_of_the_test=1,
         )
-        sim = FedGKTAPI(ds, cfg, client_blocks=1, server_blocks_per_stage=1)
-        sim_out = sim.train()
+        # one simulation run serves both transport variants (same ds/cfg/seed)
+        if "sim" not in self._sim_cache:
+            sim = FedGKTAPI(ds, cfg, client_blocks=1, server_blocks_per_stage=1)
+            self._sim_cache["sim"] = (sim, sim.train())
+        sim, sim_out = self._sim_cache["sim"]
         server = run_fedgkt_edge(ds, cfg, client_blocks=1,
                                  server_blocks_per_stage=1,
                                  comm_factory=comm_factory)

@@ -1,0 +1,243 @@
+"""Measured reference-stack baseline (VERDICT r2 #2).
+
+The reference publishes no throughput numbers, so this tool MEASURES its
+execution model instead of estimating it: an independent torch
+implementation of the reference's standalone FedAvg hot loop — sequential
+per-client training with a state-dict copy in and out per client
+(fedavg_api.py:55-66), a Python for-epoch/for-batch loop with CE loss,
+grad-norm clip and SGD-momentum (my_model_trainer_classification.py:19-53),
+and host-side weighted state-dict averaging (fedavg_api.py:100-115) — run
+on THIS host's CPU, next to fedml_tpu's one-program-per-round path on the
+same CPU backend with the identical scaled config.
+
+The printed ratio is a framework comparison on equal hardware: same model
+family (ResNet-56, CIFAR shapes), same cohort/batch/epoch schedule, same
+optimizer, fp32 both sides. It complements (not replaces) bench.py's TPU
+number, whose vs_baseline still uses the documented 8xV100 estimate.
+
+Usage: python tools/ref_bench.py [--scale tiny]  -> one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# scaled flagship config: identical for both stacks (CPU makes the full
+# 1562-records-per-client config impractical; the RATIO is the point)
+NUM_CLIENTS = 8
+COHORT = 2
+RECORDS_PER_CLIENT = 96
+BATCH_SIZE = 32
+EPOCHS = 1
+ROUNDS = 1          # measured rounds (after one warmup round per stack)
+LR, MOMENTUM, CLIP = 0.1, 0.9, 1.0
+
+
+def _client_data(seed: int = 0):
+    """One shared synthetic CIFAR-shaped federation, NCHW float32."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(NUM_CLIENTS, RECORDS_PER_CLIENT, 3, 32, 32)
+                   ).astype(np.float32)
+    y = rng.integers(0, 10, size=(NUM_CLIENTS, RECORDS_PER_CLIENT)
+                     ).astype(np.int64)
+    return x, y
+
+
+def _cohort(round_idx: int) -> np.ndarray:
+    rng = np.random.default_rng(1_000_003 + round_idx)
+    return np.sort(rng.choice(NUM_CLIENTS, COHORT, replace=False))
+
+
+# ---------------------------------------------------------------- torch side
+def build_torch_resnet56():
+    """Standard CIFAR ResNet-56 (3 stages of 9 BasicBlocks, 16/32/64
+    channels, BN+ReLU, projection shortcut) in torch — written fresh; the
+    arch is the public He et al. recipe, matching fedml_tpu's flax module."""
+    import torch
+    import torch.nn as tnn
+
+    class Block(tnn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.c1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.b1 = tnn.BatchNorm2d(cout, momentum=0.1)
+            self.c2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.b2 = tnn.BatchNorm2d(cout, momentum=0.1)
+            self.proj = None
+            if stride != 1 or cin != cout:
+                self.proj = tnn.Sequential(
+                    tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                    tnn.BatchNorm2d(cout, momentum=0.1))
+
+        def forward(self, x):
+            r = x if self.proj is None else self.proj(x)
+            y = torch.relu(self.b1(self.c1(x)))
+            y = self.b2(self.c2(y))
+            return torch.relu(y + r)
+
+    class ResNet56(tnn.Module):
+        def __init__(self, classes=10):
+            super().__init__()
+            self.stem = tnn.Conv2d(3, 16, 3, 1, 1, bias=False)
+            self.bn = tnn.BatchNorm2d(16, momentum=0.1)
+            blocks = []
+            cin = 16
+            for stage, cout in enumerate((16, 32, 64)):
+                for b in range(9):
+                    blocks.append(Block(cin, cout,
+                                        2 if stage > 0 and b == 0 else 1))
+                    cin = cout
+            self.blocks = tnn.Sequential(*blocks)
+            self.fc = tnn.Linear(64, classes)
+
+        def forward(self, x):
+            y = torch.relu(self.bn(self.stem(x)))
+            y = self.blocks(y)
+            y = y.mean(dim=(2, 3))
+            return self.fc(y)
+
+    return ResNet56()
+
+
+def run_torch(x, y):
+    """The reference execution model: per round, train each sampled client
+    SEQUENTIALLY from a fresh copy of the global weights, then weighted-
+    average the collected state dicts on the host."""
+    import torch
+    import torch.nn.functional as F
+
+    torch.manual_seed(0)
+    torch.set_num_threads(os.cpu_count() or 1)
+    model = build_torch_resnet56()
+    global_state = copy.deepcopy(model.state_dict())
+
+    def train_round(round_idx):
+        sampled = _cohort(round_idx)
+        locals_, weights = [], []
+        for k in sampled:
+            model.load_state_dict(copy.deepcopy(global_state))  # :55-60
+            model.train()
+            opt = torch.optim.SGD(model.parameters(), lr=LR, momentum=MOMENTUM)
+            for _ in range(EPOCHS):
+                order = np.random.permutation(RECORDS_PER_CLIENT)
+                for s in range(RECORDS_PER_CLIENT // BATCH_SIZE):
+                    idx = order[s * BATCH_SIZE:(s + 1) * BATCH_SIZE]
+                    bx = torch.from_numpy(x[k][idx])   # per-batch host->tensor
+                    by = torch.from_numpy(y[k][idx])
+                    opt.zero_grad()
+                    loss = F.cross_entropy(model(bx), by)
+                    loss.backward()
+                    torch.nn.utils.clip_grad_norm_(model.parameters(), CLIP)
+                    opt.step()
+            locals_.append(copy.deepcopy(model.cpu().state_dict()))  # :12-14
+            weights.append(float(RECORDS_PER_CLIENT))
+        total = sum(weights)
+        avg = {}
+        for key in locals_[0]:
+            acc = None
+            for sd, w in zip(locals_, weights):
+                t = sd[key].to(torch.float32) * (w / total)
+                acc = t if acc is None else acc + t
+            avg[key] = acc.to(locals_[0][key].dtype)
+        return avg
+
+    train_round(0)                                     # warmup
+    t0 = time.perf_counter()
+    for r in range(1, ROUNDS + 1):
+        global_state = train_round(r)
+    dt = time.perf_counter() - t0
+    images = ROUNDS * COHORT * RECORDS_PER_CLIENT * EPOCHS
+    return images / dt
+
+
+# ------------------------------------------------------------- fedml_tpu side
+def run_fedml_tpu(x, y):
+    """Same schedule through fedml_tpu on the CPU backend: the whole cohort
+    round is one jitted program (vmap of the local-SGD scan + on-device
+    weighted mean)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from fedml_tpu.core.pytree import tree_weighted_mean
+    from fedml_tpu.core.tasks import get_task
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel.local import make_local_train_fn
+
+    bundle = create_model("resnet56", 10)
+    local_train = make_local_train_fn(
+        bundle, get_task("classification"),
+        optimizer="sgd", lr=LR, momentum=MOMENTUM, grad_clip=CLIP,
+        epochs=EPOCHS, batch_size=BATCH_SIZE,
+    )
+    # NHWC for the TPU-native stack
+    xs = jnp.asarray(np.transpose(x, (0, 1, 3, 4, 2)))
+    ys = jnp.asarray(y.astype(np.int32))
+    mask = jnp.ones(ys.shape, jnp.float32)
+    counts = jnp.full((NUM_CLIENTS,), float(RECORDS_PER_CLIENT))
+    variables = bundle.init(jax.random.key(0), batch_size=BATCH_SIZE)
+
+    @jax.jit
+    def round_step(variables, cx, cy, cm, ccounts, rng):
+        res = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+            variables, cx, cy, cm, ccounts, jax.random.split(rng, cx.shape[0]))
+        return (tree_weighted_mean(res.variables, ccounts),
+                res.train_loss.sum())
+
+    def train_round(variables, round_idx):
+        sampled = jnp.asarray(_cohort(round_idx))
+        return round_step(variables,
+                          jnp.take(xs, sampled, 0), jnp.take(ys, sampled, 0),
+                          jnp.take(mask, sampled, 0),
+                          jnp.take(counts, sampled, 0),
+                          jax.random.fold_in(jax.random.key(0), round_idx))
+
+    variables, l = train_round(variables, 0)           # warmup (compile)
+    float(l)
+    t0 = time.perf_counter()
+    for r in range(1, ROUNDS + 1):
+        variables, l = train_round(variables, r)
+    float(l)
+    dt = time.perf_counter() - t0
+    images = ROUNDS * COHORT * RECORDS_PER_CLIENT * EPOCHS
+    return images / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", choices=["tiny", "bench"], default="bench",
+                   help="tiny = CI smoke of both code paths")
+    args = p.parse_args()
+    global NUM_CLIENTS, COHORT, RECORDS_PER_CLIENT, BATCH_SIZE, ROUNDS
+    if args.scale == "tiny":
+        NUM_CLIENTS, COHORT, RECORDS_PER_CLIENT, BATCH_SIZE = 4, 2, 8, 4
+    x, y = _client_data()
+    torch_rate = run_torch(x, y)
+    tpu_stack_rate = run_fedml_tpu(x, y)
+    print(json.dumps({
+        "metric": "fedavg_framework_ratio_cpu (resnet56, CIFAR shapes, fp32)",
+        "torch_ref_img_per_sec": round(torch_rate, 2),
+        "fedml_tpu_img_per_sec": round(tpu_stack_rate, 2),
+        "ratio": round(tpu_stack_rate / torch_rate, 3),
+        "config": {
+            "clients": NUM_CLIENTS, "cohort": COHORT,
+            "records_per_client": RECORDS_PER_CLIENT,
+            "batch_size": BATCH_SIZE, "epochs": EPOCHS,
+            "rounds_measured": ROUNDS, "lr": LR, "momentum": MOMENTUM,
+            "grad_clip": CLIP, "host_cpus": os.cpu_count(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
