@@ -173,7 +173,7 @@ def main():
     model = os.environ.get("BENCH_MODEL", "resnet56")
     records = 8 if tiny else RECORDS_PER_CLIENT
     rounds = 1 if tiny else MEASURE_ROUNDS
-    batch = 8 if tiny else BATCH_SIZE
+    batch = int(os.environ.get("BENCH_BATCH", 8 if tiny else BATCH_SIZE))
     cohort = 2 if tiny else CLIENTS_PER_ROUND
 
     ds = make_synthetic_classification(

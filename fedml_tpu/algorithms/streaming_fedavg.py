@@ -1,0 +1,174 @@
+"""Streaming FedAvg — federated rounds for datasets exceeding the device
+budget (VERDICT r2 #6).
+
+The in-memory paradigm (FedAvgAPI) holds the stacked federation in HBM and
+trains the cohort as one vmapped program. At ImageNet/Landmarks scale that
+stack does not fit; the reference streams every dataset through DataLoader
+worker processes instead (cifar10/data_loader.py:160-233). This is the
+TPU-native counterpart: client records stay HOST-resident, the native
+threaded pipeline (fedml_tpu/native.HostPipeline, C++ workers) assembles
+shuffled batches off-GIL into a bounded ring, `device_stream` keeps
+transfers in flight ahead of the consumer, and the device runs one jitted
+per-batch SGD step — host batch assembly, host->device transfer, and device
+compute all overlap; host memory is bounded by the pipeline ring
+(depth x batch), device memory by one client's working set.
+
+Numerical parity with the in-memory path is EXACT by construction, not
+approximate: the pipeline runs in explicit-order mode with the same
+per-epoch shuffle the jitted scan derives (perm = random.permutation(ekey),
+real-records-first stable sort; batch keys split(fold_in(ekey, 0x5ba7)) —
+see parallel/local.make_local_train_fn), and the in-memory path's masked
+padding steps are no-ops (live=0 freezes params/opt/stats and zeroes the
+loss), so streaming ONLY the real batches reproduces the identical update
+sequence. tests/test_streaming_fedavg.py pins rounds equal to FedAvgAPI.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.rng import round_key
+from fedml_tpu.parallel.local import LocalResult
+
+log = logging.getLogger(__name__)
+
+# must match parallel/local.make_local_train_fn's batch-key derivation
+_BATCH_KEY_TAG = 0x5BA7
+
+
+class StreamingFedAvgAPI(FedAvgAPI):
+    """FedAvg whose clients stream host-resident batches through the native
+    pipeline; cohort clients train sequentially on the device (the price of
+    not fitting in HBM), aggregation and the elastic-round guard are the
+    shared ``_finish_round``."""
+
+    supports_device_data = False  # the point is that data does NOT go resident
+    elastic_rounds_ok = True      # zero-weight failures via _finish_round
+
+    def __init__(self, dataset, config, bundle=None, n_threads: int = 2,
+                 depth: int = 4):
+        self.n_threads, self.depth = n_threads, depth
+        super().__init__(dataset, config, bundle)
+        self._batch_step = self._build_batch_step()
+        self._opt_init = jax.jit(lambda p: self._opt_tx.init(p))
+        self._finish_jit = jax.jit(self._finish_round)
+        # host-resident client slices (numpy views, never device_put whole)
+        ds = self.dataset
+        self._host_x = np.asarray(ds.train_x)
+        self._host_y = np.asarray(ds.train_y)
+        self._host_mask = np.asarray(ds.train_mask)
+
+    def build_round_step(self):
+        # rounds are driven batch-by-batch in run_round; there is no single
+        # whole-round XLA program to build on this paradigm
+        return None
+
+    def _build_batch_step(self):
+        from fedml_tpu.parallel.local import make_batch_sgd_step, make_optimizer
+
+        c = self.config
+        tx = make_optimizer(c.client_optimizer, c.lr, c.momentum, c.wd)
+        self._opt_tx = tx
+        # the SAME per-batch step make_local_train_fn scans — shared
+        # definition, so the streaming path cannot drift from the in-memory
+        # one (params0 threaded for FedProx-style subclasses)
+        step = make_batch_sgd_step(
+            self.bundle, self.task, tx, grad_clip=c.grad_clip,
+            compute_dtype=jnp.bfloat16 if c.dtype == "bfloat16" else None,
+        )
+        return jax.jit(step)
+
+    def _client_orders(self, mask, count, rng):
+        """The jitted scan's exact per-epoch order, truncated to the real
+        batches: perm(ekey) stable-sorted real-first; only the first
+        ceil(count/bs) batches carry live steps (the rest are frozen no-ops
+        in the in-memory path), so only they are streamed."""
+        c = self.config
+        n_pad = mask.shape[0]
+        bs = c.batch_size
+        steps_real = int(np.ceil(max(float(count), 1.0) / bs))
+        mask_d = jnp.asarray(mask)
+        ekeys = jax.random.split(rng, c.epochs)
+        orders = []
+        for e in range(c.epochs):
+            perm = jax.random.permutation(ekeys[e], n_pad)
+            order = perm[jnp.argsort(-mask_d[perm], stable=True)]
+            orders.append(np.asarray(order[: steps_real * bs]))
+        return np.stack(orders), ekeys, steps_real
+
+    def _train_client_streaming(self, k: int, rng):
+        """One client's local run: ordered native pipeline over its host
+        slice + the per-batch jitted step. Returns (variables, last-epoch
+        mean loss, tau)."""
+        from fedml_tpu.data.pipeline import HostPipeline, device_stream
+
+        c = self.config
+        bs = c.batch_size
+        x, y, mask = self._host_x[k], self._host_y[k], self._host_mask[k]
+        count = float(self.dataset.train_counts[k])
+        orders, ekeys, steps_real = self._client_orders(mask, count, rng)
+        n_pad = mask.shape[0]
+        steps_full = n_pad // bs
+
+        variables = self.variables
+        params0 = variables["params"]
+        opt_state = self._opt_init(params0)
+        pipe = HostPipeline(x, None, bs, n_threads=self.n_threads,
+                            depth=self.depth, orders=orders)
+        try:
+            stream = device_stream(pipe, n_batches=c.epochs * steps_real)
+            for e in range(c.epochs):
+                bkeys = jax.random.split(
+                    jax.random.fold_in(ekeys[e], _BATCH_KEY_TAG), steps_full)
+                # labels/mask are tiny next to x: stage the whole epoch's
+                # once so the hot loop has no per-step host->device hops
+                # beyond the prefetched x stream
+                by_e = jnp.asarray(y[orders[e]]).reshape((steps_real, bs)
+                                                         + y.shape[1:])
+                bm_e = jnp.asarray(mask[orders[e]], jnp.float32).reshape(
+                    (steps_real, bs))
+                ep_loss = jnp.zeros(())
+                for s in range(steps_real):
+                    bx, _ = next(stream)
+                    variables, opt_state, l = self._batch_step(
+                        variables, opt_state, params0, bx, by_e[s], bm_e[s],
+                        bkeys[s])
+                    ep_loss = ep_loss + l
+                last_loss = ep_loss / max(steps_real, 1)
+        finally:
+            pipe.close()
+        tau = jnp.float32(c.epochs * steps_real)
+        return variables, last_loss, tau
+
+    def run_round(self, round_idx: int):
+        sampled, live, _bucket = self._round_plan(round_idx, record=True)
+        rk = round_key(self.root_key, round_idx)
+        keys = jax.random.split(rk, len(sampled))
+        outs, losses, taus = [], [], []
+        counts = np.asarray(self.dataset.train_counts, np.float32)[sampled]
+        if live is not None:
+            counts = counts * live
+        for i, k in enumerate(sampled):
+            if counts[i] <= 0:
+                # failed client: zero aggregation weight — its (skipped)
+                # training result cannot influence the round, so train a
+                # placeholder from the current globals for tree shape only
+                outs.append(self.variables)
+                losses.append(jnp.zeros(()))
+                taus.append(jnp.zeros(()))
+                continue
+            v, l, tau = self._train_client_streaming(int(k), keys[i])
+            outs.append(v)
+            losses.append(l)
+            taus.append(tau)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+        res = LocalResult(stacked, jnp.stack(losses), jnp.stack(taus))
+        self.variables, self.server_state, train_loss = self._finish_jit(
+            self.variables, self.server_state, res,
+            jnp.asarray(counts, jnp.float32), rk)
+        return train_loss if self.config.async_rounds else float(train_loss)

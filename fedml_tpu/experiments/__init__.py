@@ -28,6 +28,7 @@ ALGORITHMS = (
     "crosssilo_fedopt", "crosssilo_fednova", "crosssilo_fedagc",
     "crosssilo_fedavg_robust", "crosssilo_fedprox", "crosssilo_decentralized",
     "crosssilo_fedseg", "crosssilo_hierarchical", "crosssilo_fednas",
+    "streaming_fedavg",
 )
 
 
@@ -151,10 +152,12 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
     )
     from fedml_tpu.algorithms.robust import CrossSiloFedAvgRobustAPI, FedAvgRobustAPI
     from fedml_tpu.algorithms.silo import SiloRunner
+    from fedml_tpu.algorithms.streaming_fedavg import StreamingFedAvgAPI
     from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
 
     simple = {
         "fedavg": FedAvgAPI,
+        "streaming_fedavg": StreamingFedAvgAPI,
         "crosssilo_fedavg": CrossSiloFedAvgAPI,
         "crosssilo_fedopt": CrossSiloFedOptAPI,
         "crosssilo_fednova": CrossSiloFedNovaAPI,

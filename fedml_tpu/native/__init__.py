@@ -58,6 +58,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
             ctypes.c_int, ctypes.c_int,
         ]
+        lib.fed_pipeline_create_ordered.restype = ctypes.c_void_p
+        lib.fed_pipeline_create_ordered.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ]
         lib.fed_pipeline_next.restype = ctypes.c_int64
         lib.fed_pipeline_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.fed_pipeline_batches_per_epoch.restype = ctypes.c_int64
@@ -191,7 +197,14 @@ class HostPipeline:
 
     def __init__(self, x: np.ndarray, y: Optional[np.ndarray], batch_size: int,
                  seed: int = 0, n_threads: int = 2, depth: int = 4,
-                 drop_last: bool = False):
+                 drop_last: bool = False,
+                 orders: Optional[np.ndarray] = None):
+        """``orders`` switches to explicit-order mode: a [n_epochs, L] int64
+        index table; epoch e streams records x[orders[e % n_epochs]] in that
+        exact sequence (L need not equal len(x) — e.g. a federated trainer
+        streaming only the real records of a padded client slice while
+        reproducing its jitted scan's shuffle). ``seed``/``drop_last`` are
+        ignored in this mode."""
         self.x = np.ascontiguousarray(x)
         self.y = None if y is None else np.ascontiguousarray(y)
         if self.y is not None and len(self.y) != len(self.x):
@@ -200,8 +213,18 @@ class HostPipeline:
         self.seed = int(seed)
         self.drop_last = bool(drop_last)
         n = len(self.x)
-        self.batches_per_epoch = (n // self.batch_size if drop_last
-                                  else -(-n // self.batch_size))
+        if orders is not None:
+            orders = np.ascontiguousarray(orders, np.int64)
+            if orders.ndim != 2 or orders.size == 0:
+                raise ValueError("orders must be a non-empty [n_epochs, L] table")
+            if orders.min() < 0 or orders.max() >= n:
+                raise ValueError("orders entries out of range")
+            self.orders = orders
+            self.batches_per_epoch = -(-orders.shape[1] // self.batch_size)
+        else:
+            self.orders = None
+            self.batches_per_epoch = (n // self.batch_size if drop_last
+                                      else -(-n // self.batch_size))
         if self.batches_per_epoch <= 0:
             raise ValueError("dataset smaller than one batch with drop_last")
         self._handle = None
@@ -209,12 +232,21 @@ class HostPipeline:
         if self._lib is not None:
             xb = self.x.nbytes // n
             yb = 0 if self.y is None else self.y.nbytes // n
-            self._handle = self._lib.fed_pipeline_create(
-                self.x.ctypes.data,
-                0 if self.y is None else self.y.ctypes.data,
-                n, xb, yb, self.batch_size, self.seed,
-                int(n_threads), int(depth), int(drop_last),
-            )
+            if self.orders is not None:
+                self._handle = self._lib.fed_pipeline_create_ordered(
+                    self.x.ctypes.data,
+                    0 if self.y is None else self.y.ctypes.data,
+                    n, xb, yb, self.batch_size,
+                    self.orders.ctypes.data, self.orders.shape[0],
+                    self.orders.shape[1], int(n_threads), int(depth),
+                )
+            else:
+                self._handle = self._lib.fed_pipeline_create(
+                    self.x.ctypes.data,
+                    0 if self.y is None else self.y.ctypes.data,
+                    n, xb, yb, self.batch_size, self.seed,
+                    int(n_threads), int(depth), int(drop_last),
+                )
         if self._handle is None:
             self._rng_epoch = 0
             self._py_iter = self._python_stream()
@@ -223,8 +255,11 @@ class HostPipeline:
         n = len(self.x)
         epoch = 0
         while True:
-            rng = np.random.default_rng(self.seed + epoch * 1_000_003)
-            perm = rng.permutation(n)
+            if self.orders is not None:
+                perm = self.orders[epoch % self.orders.shape[0]]
+            else:
+                rng = np.random.default_rng(self.seed + epoch * 1_000_003)
+                perm = rng.permutation(n)
             nb = self.batches_per_epoch
             for b in range(nb):
                 ix = perm[b * self.batch_size:(b + 1) * self.batch_size]

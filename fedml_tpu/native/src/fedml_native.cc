@@ -27,6 +27,7 @@
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <thread>
@@ -150,6 +151,13 @@ struct Pipeline {
   uint64_t seed;
   int64_t n_batches;  // per epoch
 
+  // Explicit-order mode (fed_pipeline_create_ordered): the consumer supplies
+  // the exact per-epoch record order — e.g. a federated trainer reproducing
+  // its jitted scan's shuffle stream — instead of the internal Fisher-Yates.
+  // Owned copy, [ext_epochs, ext_len] row-major; epochs wrap modulo.
+  std::vector<int64_t> ext_orders;
+  int64_t ext_epochs = 0, ext_len = 0;
+
   std::vector<Slot> slots;
   std::atomic<int64_t> next_fetch{0};  // next batch seq to be produced
   int64_t next_deliver = 0;            // next batch seq the consumer takes
@@ -158,35 +166,47 @@ struct Pipeline {
   bool stop = false;
   std::vector<std::thread> workers;
 
-  // Permutations per epoch, built lazily; pruned below the oldest epoch any
-  // in-flight batch can reference (workers run at most `depth` batches ahead
-  // of the consumer, so keeping the last two epochs is always enough).
-  std::map<int64_t, std::vector<int64_t>> perms;
+  // Permutations per epoch, built lazily and handed out as shared_ptr so a
+  // worker mid-copy keeps its epoch's permutation alive even after the map
+  // prunes it (with tiny datasets the `depth` in-flight batches can span
+  // MORE epochs than the prune window — a raw reference would dangle).
+  std::map<int64_t, std::shared_ptr<std::vector<int64_t>>> perms;
   std::mutex perm_mu;
 
-  const std::vector<int64_t>& perm_for_epoch(int64_t e) {
+  std::shared_ptr<std::vector<int64_t>> perm_for_epoch(int64_t e) {
     std::lock_guard<std::mutex> g(perm_mu);
     auto it = perms.find(e);
     if (it != perms.end()) return it->second;
-    std::vector<int64_t> p(n_records);
-    for (int64_t i = 0; i < n_records; ++i) p[i] = i;
+    auto p = std::make_shared<std::vector<int64_t>>(n_records);
+    for (int64_t i = 0; i < n_records; ++i) (*p)[i] = i;
     std::mt19937_64 rng(seed + static_cast<uint64_t>(e) * 0x9E3779B97F4A7C15ull);
     for (int64_t i = n_records - 1; i > 0; --i) {
       int64_t j = static_cast<int64_t>(rng() % static_cast<uint64_t>(i + 1));
-      std::swap(p[i], p[j]);
+      std::swap((*p)[i], (*p)[j]);
     }
     while (perms.size() >= 3) perms.erase(perms.begin());
-    return perms.emplace(e, std::move(p)).first->second;
+    perms.emplace(e, p);
+    return p;
   }
 
   void fill(int64_t seq_no, Slot& s) {
     int64_t epoch = seq_no / n_batches;
     int64_t b = seq_no % n_batches;
-    const auto& perm = perm_for_epoch(epoch);
     int64_t start = b * batch;
-    int64_t count = std::min(batch, n_records - start);
+    const int64_t* src_idx;
+    int64_t limit;
+    std::shared_ptr<std::vector<int64_t>> perm_keepalive;
+    if (!ext_orders.empty()) {
+      src_idx = ext_orders.data() + (epoch % ext_epochs) * ext_len + start;
+      limit = ext_len;
+    } else {
+      perm_keepalive = perm_for_epoch(epoch);
+      src_idx = perm_keepalive->data() + start;
+      limit = n_records;
+    }
+    int64_t count = std::min(batch, limit - start);
     for (int64_t r = 0; r < count; ++r) {
-      int64_t src = perm[start + r];
+      int64_t src = src_idx[r];
       std::memcpy(s.x.data() + r * x_rec_bytes, x + src * x_rec_bytes,
                   x_rec_bytes);
       if (y_rec_bytes)
@@ -241,6 +261,48 @@ extern "C" void* fed_pipeline_create(const uint8_t* x, const uint8_t* y,
     delete p;
     return nullptr;
   }
+  if (depth < 2) depth = 2;
+  p->slots.resize(depth);
+  for (auto& s : p->slots) {
+    s.x.resize(static_cast<size_t>(batch) * x_rec_bytes);
+    s.y.resize(static_cast<size_t>(batch) * (y_rec_bytes ? y_rec_bytes : 1));
+  }
+  if (n_threads < 1) n_threads = 1;
+  n_threads = std::min<int>(n_threads, depth);
+  for (int t = 0; t < n_threads; ++t)
+    p->workers.emplace_back([p] { p->worker_loop(); });
+  return p;
+}
+
+// Explicit-order creation: the consumer supplies the exact per-epoch record
+// order ([n_epochs, order_len] row-major, epochs wrap modulo) instead of the
+// internal Fisher-Yates — used by the streaming federated trainer to
+// reproduce its jitted scan's shuffle stream.
+extern "C" void* fed_pipeline_create_ordered(
+    const uint8_t* x, const uint8_t* y, int64_t n_records,
+    int64_t x_rec_bytes, int64_t y_rec_bytes, int64_t batch,
+    const int64_t* orders, int64_t n_epochs, int64_t order_len,
+    int n_threads, int depth) {
+  if (n_records <= 0 || batch <= 0 || x_rec_bytes <= 0 || orders == nullptr ||
+      n_epochs <= 0 || order_len <= 0)
+    return nullptr;
+  // validate indices up front: a bad order entry must fail create, not
+  // corrupt a worker thread mid-copy
+  for (int64_t i = 0; i < n_epochs * order_len; ++i)
+    if (orders[i] < 0 || orders[i] >= n_records) return nullptr;
+  auto* p = new Pipeline;
+  p->x = x;
+  p->y = y;
+  p->n_records = n_records;
+  p->x_rec_bytes = x_rec_bytes;
+  p->y_rec_bytes = y_rec_bytes;
+  p->batch = batch;
+  p->drop_last = false;
+  p->seed = 0;
+  p->ext_orders.assign(orders, orders + n_epochs * order_len);
+  p->ext_epochs = n_epochs;
+  p->ext_len = order_len;
+  p->n_batches = (order_len + batch - 1) / batch;
   if (depth < 2) depth = 2;
   p->slots.resize(depth);
   for (auto& s : p->slots) {

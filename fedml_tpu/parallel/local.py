@@ -63,6 +63,55 @@ class LocalResult(NamedTuple):
     tau: jax.Array         # number of optimizer steps taken (FedNova)
 
 
+def make_batch_sgd_step(
+    bundle: ModelBundle,
+    task: Task,
+    tx: optax.GradientTransformation,
+    *,
+    grad_clip: Optional[float] = None,
+    prox_mu: float = 0.0,
+    compute_dtype=None,
+):
+    """ONE minibatch SGD step — the single definition of the per-batch
+    update both execution forms share: ``make_local_train_fn`` scans it (with
+    dead-step freezing around it) and the streaming paradigm
+    (algorithms/streaming_fedavg.py) drives it batch-by-batch, so the two
+    paths cannot drift apart numerically.
+
+    Returns ``step(variables, opt_state, params0, bx, by, bm, bkey) ->
+    (new_variables, new_opt_state, loss)``; ``params0`` anchors the FedProx
+    proximal term (ignored when prox_mu == 0).
+    """
+
+    def batch_step(variables, opt_state, params0, bx, by, bm, bkey):
+        if compute_dtype is not None and jnp.issubdtype(bx.dtype, jnp.floating):
+            bx = bx.astype(compute_dtype)
+
+        def loss_fn(p):
+            vars_in = dict(variables)
+            vars_in["params"] = p
+            logits, new_vars = bundle.apply_train(vars_in, bx, bkey)
+            l = task.loss(logits, by, bm)
+            if prox_mu:
+                d = tree_sub(p, params0)
+                l = l + 0.5 * prox_mu * tree_dot(d, d)
+            return l, new_vars
+
+        (l, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            variables["params"]
+        )
+        if grad_clip:
+            gnorm = optax.global_norm(grads)
+            scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, new_opt_state = tx.update(grads, opt_state, variables["params"])
+        out_vars = dict(new_vars)
+        out_vars["params"] = optax.apply_updates(variables["params"], updates)
+        return out_vars, new_opt_state, l
+
+    return batch_step
+
+
 def make_local_train_fn(
     bundle: ModelBundle,
     task: Task,
@@ -92,6 +141,12 @@ def make_local_train_fn(
     the per-client tau in LocalResult honest for FedNova.
     """
     tx = make_optimizer(optimizer, lr, momentum, wd)
+    # x is pre-cast once per client below, so the shared step's own cast is
+    # a no-op; prox anchors at the round's incoming params (params0)
+    batch_step = make_batch_sgd_step(
+        bundle, task, tx, grad_clip=grad_clip, prox_mu=prox_mu,
+        compute_dtype=None,
+    )
 
     def local_train(variables: dict, x, y, mask, count, rng) -> LocalResult:
         n_pad = x.shape[0]
@@ -122,26 +177,9 @@ def make_local_train_fn(
                 variables, opt_state = carry
                 bx, by, bm, bkey, step_idx = batch
                 live = (step_idx < steps_real).astype(jnp.float32)
-
-                def loss_fn(p):
-                    vars_in = dict(variables)
-                    vars_in["params"] = p
-                    logits, new_vars = bundle.apply_train(vars_in, bx, bkey)
-                    l = task.loss(logits, by, bm)
-                    if prox_mu:
-                        d = tree_sub(p, params0)
-                        l = l + 0.5 * prox_mu * tree_dot(d, d)
-                    return l, new_vars
-
-                (l, new_vars), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                    variables["params"]
+                new_vars, new_opt_state, l = batch_step(
+                    variables, opt_state, params0, bx, by, bm, bkey
                 )
-                if grad_clip:
-                    gnorm = optax.global_norm(grads)
-                    scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
-                    grads = jax.tree.map(lambda g: g * scale, grads)
-                updates, new_opt_state = tx.update(grads, opt_state, variables["params"])
-                params = optax.apply_updates(variables["params"], updates)
 
                 # freeze params/opt/stats on dead (padding-only) steps
                 def freeze_if_dead(new, old):
@@ -152,11 +190,7 @@ def make_local_train_fn(
                     )
 
                 new_opt_state = freeze_if_dead(new_opt_state, opt_state)
-                out_vars = dict(freeze_if_dead(
-                    {k: v for k, v in new_vars.items() if k != "params"},
-                    {k: v for k, v in variables.items() if k != "params"},
-                ))
-                out_vars["params"] = freeze_if_dead(params, variables["params"])
+                out_vars = dict(freeze_if_dead(new_vars, variables))
                 return (out_vars, new_opt_state), l * live
 
             (variables, opt_state), losses = jax.lax.scan(

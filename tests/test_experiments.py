@@ -134,6 +134,8 @@ def test_dispatcher_covers_fednas_and_fedseg_and_nothing_is_missed():
         "crosssilo_fedprox", "crosssilo_decentralized", "crosssilo_fedseg",
         "crosssilo_hierarchical", "crosssilo_fednas", "splitnn", "fednas",
         "fedseg",
+        # dedicated test module: tests/test_streaming_fedavg.py
+        "streaming_fedavg",
         # remaining-standalone parametrize
         "fedagc", "fedavg_robust", "hierarchical", "decentralized",
         "silo_fedavg", "silo_fedopt", "silo_fednova", "silo_fedagc",
