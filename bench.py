@@ -188,6 +188,7 @@ def main():
         batch_size=batch, epochs=EPOCHS, lr=0.1, momentum=0.9,
         dtype="bfloat16", frequency_of_the_test=10_000, seed=0,
         bucket_groups=int(os.environ.get("BENCH_BUCKET_GROUPS", "6")),
+        scan_unroll=int(os.environ.get("BENCH_UNROLL", "1")),
         # rounds return device-scalar losses (no per-round host sync): the
         # timed loop pipelines dispatches and blocks ONCE at the end, so the
         # remote-dispatch latency (~100 ms/sync through the tunnel) overlaps

@@ -137,14 +137,21 @@ class FedAvgAPI:
 
     # -- factory methods subclasses override ---------------------------------
 
-    def build_local_train(self):
+    def _local_train_kwargs(self) -> dict:
+        """The ONE config->trainer kwargs mapping, shared by every
+        build_local_train (subclasses add to it rather than re-listing it,
+        so a new config knob cannot be silently dropped by one algorithm)."""
         c = self.config
-        return make_local_train_fn(
-            self.bundle, self.task,
+        return dict(
             optimizer=c.client_optimizer, lr=c.lr, momentum=c.momentum, wd=c.wd,
             epochs=c.epochs, batch_size=c.batch_size, grad_clip=c.grad_clip,
             compute_dtype=jnp.bfloat16 if c.dtype == "bfloat16" else None,
+            scan_unroll=c.scan_unroll,
         )
+
+    def build_local_train(self):
+        return make_local_train_fn(self.bundle, self.task,
+                                   **self._local_train_kwargs())
 
     def init_server_state(self):
         """State threaded through aggregate() across rounds (FedOpt's server

@@ -14,21 +14,16 @@ Aggregation is unchanged FedAvg.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
 from fedml_tpu.parallel.local import make_local_train_fn
 
 
 class FedProxAPI(FedAvgAPI):
     def build_local_train(self):
-        c = self.config
         return make_local_train_fn(
             self.bundle, self.task,
-            optimizer=c.client_optimizer, lr=c.lr, momentum=c.momentum, wd=c.wd,
-            epochs=c.epochs, batch_size=c.batch_size, grad_clip=c.grad_clip,
-            prox_mu=c.fedprox_mu,
-            compute_dtype=jnp.bfloat16 if c.dtype == "bfloat16" else None,
+            prox_mu=self.config.fedprox_mu,
+            **self._local_train_kwargs(),
         )
 
 

@@ -125,6 +125,7 @@ def make_local_train_fn(
     grad_clip: Optional[float] = None,
     prox_mu: float = 0.0,
     compute_dtype=None,
+    scan_unroll: int = 1,
 ) -> Callable[[dict, jax.Array, jax.Array, jax.Array, jax.Array], LocalResult]:
     """Build ``local_train(variables, x, y, mask, count, rng) -> LocalResult``.
 
@@ -196,6 +197,7 @@ def make_local_train_fn(
             (variables, opt_state), losses = jax.lax.scan(
                 step_fn, (variables, opt_state),
                 (xs, ys, ms, bkeys, jnp.arange(steps)),
+                unroll=max(int(scan_unroll), 1),
             )
             mean_loss = jnp.sum(losses) / jnp.maximum(steps_real.astype(jnp.float32), 1.0)
             return (variables, opt_state), mean_loss

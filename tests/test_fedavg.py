@@ -408,3 +408,34 @@ def test_reference_synthetic_benchmark_parity(dataset):
                                           input_shape=ds.train_x.shape[2:]))
     hist = api.train()
     assert hist["Test/Acc"][-1] > 0.60, (dataset, hist["Test/Acc"])
+
+
+def test_scan_unroll_is_exact():
+    """scan_unroll only changes XLA scheduling (fused adjacent steps), never
+    the update sequence: rounds must be identical to the rolled loop."""
+    import jax
+
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.models import create_model
+
+    ds = make_synthetic_classification(
+        "unroll", (10,), 3, 4, records_per_client=21,
+        partition_method="hetero", partition_alpha=0.5, batch_size=4, seed=2)
+
+    def run(unroll):
+        cfg = FedConfig(model="lr", client_num_in_total=4,
+                        client_num_per_round=4, comm_round=2, epochs=2,
+                        batch_size=4, lr=0.2, momentum=0.9, seed=3,
+                        frequency_of_the_test=100, scan_unroll=unroll)
+        api = FedAvgAPI(ds, cfg, create_model("lr", ds.class_num,
+                                              input_shape=(10,)))
+        losses = [float(api.run_round(r)) for r in range(2)]
+        return api, losses
+
+    base, l1 = run(1)
+    unrolled, l4 = run(4)
+    assert l1 == pytest.approx(l4, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(base.variables),
+                    jax.tree.leaves(unrolled.variables)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-6, atol=1e-7)
