@@ -123,6 +123,9 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
         comm_round=rounds, batch_size=batch, epochs=EPOCHS, lr=0.1,
         momentum=0.9, dtype="bfloat16", frequency_of_the_test=10_000,
         seed=0, async_rounds=True,
+        # the grouped mesh schedule (strip-dealt clients, per-group scan
+        # lengths) is the measured configuration, like the sim paradigm's
+        bucket_groups=int(os.environ.get("BENCH_BUCKET_GROUPS", "6")),
         # force residency even on the CPU smoke path so tiny mode exercises
         # the same resident-sharded branch the TPU run measures
         device_data="on",
@@ -138,12 +141,16 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
         last = api.run_round(r)
     float(last)
     dt = time.perf_counter() - t0
-    n_pad = int(ds.train_x.shape[1])
-    real = int(ds.train_counts.sum()) * EPOCHS * rounds
-    padded = n_pad * clients * EPOCHS * rounds
+    real = padded = 0
+    for r in range(1, rounds + 1):
+        re, pa = api.round_counts(r)
+        real += re * EPOCHS
+        padded += pa * EPOCHS
     return {
-        "paradigm": "crosssilo shard_map psum, full participation, resident-sharded",
+        "paradigm": "crosssilo shard_map psum, full participation, "
+                    "resident-sharded, grouped scan schedule",
         "clients": clients,
+        "grouped_schedule": api._group_plan is not None,
         "images_per_sec": round(real / dt, 1),
         "padded_images_per_sec": round(padded / dt, 1),
         "rounds_per_sec": round(rounds / dt, 4),
@@ -189,6 +196,7 @@ def main():
         dtype="bfloat16", frequency_of_the_test=10_000, seed=0,
         bucket_groups=int(os.environ.get("BENCH_BUCKET_GROUPS", "6")),
         scan_unroll=int(os.environ.get("BENCH_UNROLL", "1")),
+        cohort_vmap_width=int(os.environ.get("BENCH_COHORT_WIDTH", "0")),
         # rounds return device-scalar losses (no per-round host sync): the
         # timed loop pipelines dispatches and blocks ONCE at the end, so the
         # remote-dispatch latency (~100 ms/sync through the tunnel) overlaps

@@ -51,11 +51,10 @@ class CentralizedTrainer:
         self.root_key = seed_everything(config.seed)
         self.variables = self.bundle.init(self.root_key)
         self.x, self.y, self.mask = merge_clients(dataset, config.batch_size)
+        from fedml_tpu.parallel.local import local_train_kwargs
+
         self._train = jax.jit(make_local_train_fn(
-            self.bundle, self.task,
-            optimizer=config.client_optimizer, lr=config.lr, momentum=config.momentum,
-            wd=config.wd, epochs=config.epochs, batch_size=config.batch_size,
-            grad_clip=config.grad_clip,
+            self.bundle, self.task, **local_train_kwargs(config),
         ))
         self._eval = make_eval_fn(self.bundle, self.task)
 

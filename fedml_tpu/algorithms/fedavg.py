@@ -40,6 +40,29 @@ from fedml_tpu.parallel.local import (
 log = logging.getLogger(__name__)
 
 
+def _chunk_buckets(sorted_maxes, G: int, q: int, n_pad: int) -> list:
+    """The ONE grouping core both bucket schedulers share (the sim paradigm's
+    _round_groups over sorted client counts, the mesh paradigm's
+    _mesh_group_plan over sorted per-strip maxes): split the ascending
+    max-count sequence into at most ``G`` contiguous chunks, give each chunk
+    the scan length of its largest member rounded up to quantum ``q`` (capped
+    at ``n_pad``), and merge adjacent chunks whose scan lengths round equal.
+    Returns ``[[a, b, scan_len], ...]`` half-open index chunks."""
+    n = len(sorted_maxes)
+    bounds = np.linspace(0, n, G + 1).round().astype(int)
+    merged: list[list] = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        if a == b:
+            continue
+        bucket = min(int(np.ceil(max(float(sorted_maxes[b - 1]), 1.0) / q) * q),
+                     n_pad)
+        if merged and merged[-1][2] == bucket:
+            merged[-1][1] = b
+        else:
+            merged.append([a, b, bucket])
+    return merged
+
+
 class FedAvgAPI:
     """Standalone FedAvg simulator (vmap-over-clients on one chip/mesh)."""
 
@@ -104,22 +127,27 @@ class FedAvgAPI:
             jax.device_put(jnp.asarray(ds.train_counts, jnp.float32)),
         )
 
-    def _eligible_device_train_x(self, shard_factor: int = 1):
+    def _eligible_device_train_x(self, shard_factor: int = 1,
+                                 slots_fraction: float = 1.0):
         """Shared device-residency eligibility + bf16 pre-cast for train_x.
 
         ``shard_factor`` = number of devices the stacked arrays will be
         sharded across (1 = fully replicated/single-device): the 'auto'
-        byte budget applies to the PER-DEVICE footprint. Auto also declines
-        CPU backends — there is no host->device hop to avoid, and a second
-        in-RAM copy of the dataset would be pure cost ('on' still forces
-        it, e.g. for tests). Returns train_x (bf16-cast when training in
-        bf16) or None when ineligible."""
+        byte budget applies to the PER-DEVICE footprint. ``slots_fraction``
+        scales the estimate when the caller will truncate the record axis
+        before placement (the grouped mesh schedule keeps only each group's
+        scan length, so its footprint is sum(n_g * len_g) / (C * n_pad) of
+        the full stack). Auto also declines CPU backends — there is no
+        host->device hop to avoid, and a second in-RAM copy of the dataset
+        would be pure cost ('on' still forces it, e.g. for tests). Returns
+        train_x (bf16-cast when training in bf16) or None when ineligible."""
         c = self.config
         ds = self.dataset
         x = ds.train_x
         cast_bf16 = c.dtype == "bfloat16" and np.issubdtype(x.dtype, np.floating)
         nbytes = ((x.size * 2 if cast_bf16 else x.nbytes) + ds.train_y.nbytes
                   + ds.train_mask.nbytes + ds.train_counts.nbytes)
+        nbytes *= slots_fraction
         if c.device_data == "auto" and (
             jax.default_backend() == "cpu"
             or nbytes / max(shard_factor, 1) > c.device_data_max_bytes
@@ -138,16 +166,13 @@ class FedAvgAPI:
     # -- factory methods subclasses override ---------------------------------
 
     def _local_train_kwargs(self) -> dict:
-        """The ONE config->trainer kwargs mapping, shared by every
-        build_local_train (subclasses add to it rather than re-listing it,
-        so a new config knob cannot be silently dropped by one algorithm)."""
-        c = self.config
-        return dict(
-            optimizer=c.client_optimizer, lr=c.lr, momentum=c.momentum, wd=c.wd,
-            epochs=c.epochs, batch_size=c.batch_size, grad_clip=c.grad_clip,
-            compute_dtype=jnp.bfloat16 if c.dtype == "bfloat16" else None,
-            scan_unroll=c.scan_unroll,
-        )
+        """The ONE config->trainer kwargs mapping (parallel/local.py
+        local_train_kwargs), shared by every build_local_train — subclasses
+        add to it rather than re-listing it, so a new config knob cannot be
+        silently dropped by one algorithm."""
+        from fedml_tpu.parallel.local import local_train_kwargs
+
+        return local_train_kwargs(self.config)
 
     def build_local_train(self):
         return make_local_train_fn(self.bundle, self.task,
@@ -174,8 +199,36 @@ class FedAvgAPI:
         Returns (new_variables, new_server_state); must be jit-pure."""
         return tree_weighted_mean(stacked_vars, counts), server_state
 
+    def _cohort_train(self, variables, cx, cy, cm, counts, keys) -> LocalResult:
+        """Train a stacked cohort: one vmap (default), or — with
+        config.cohort_vmap_width = k > 0 — lax.map over chunks of k vmapped
+        clients. The chunked schedule computes the exact same per-client
+        results in the same stacking order; it exists because the full vmap
+        fuses all clients' convs into one grouped convolution whose TPU
+        lowering pads cohort-fold (docs/mfu_experiments.md H4)."""
+        vt = jax.vmap(self._local_train, in_axes=(None, 0, 0, 0, 0, 0))
+        n = cx.shape[0]
+        w = self.config.cohort_vmap_width
+        if w <= 0 or w >= n or n % w:
+            if 0 < w < n and n % w and not getattr(self, "_warned_cohort_width", False):
+                log.warning(
+                    "cohort_vmap_width=%d does not divide a cohort/group of "
+                    "%d clients; falling back to the full vmap schedule for "
+                    "such groups", w, n)
+                self._warned_cohort_width = True
+            return vt(variables, cx, cy, cm, counts, keys)
+
+        def rs(a):
+            return a.reshape((n // w, w) + a.shape[1:])
+
+        res = jax.lax.map(
+            lambda args: vt(variables, *args),
+            (rs(cx), rs(cy), rs(cm), rs(counts), rs(keys)),
+        )
+        return jax.tree.map(lambda a: a.reshape((n,) + a.shape[2:]), res)
+
     def _round_body(self, variables, server_state, cx, cy, cm, counts, rng):
-        res = jax.vmap(self._local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+        res = self._cohort_train(
             variables, cx, cy, cm, counts, jax.random.split(rng, cx.shape[0])
         )
         return self._finish_round(variables, server_state, res, counts, rng)
@@ -269,18 +322,9 @@ class FedAvgAPI:
         if live is not None:
             counts = counts * live
         perm = np.argsort(counts, kind="stable")
-        sc = counts[perm]
-        G = min(c.bucket_groups, len(sampled))
-        bounds = np.linspace(0, len(sampled), G + 1).round().astype(int)
-        groups: list[list[int]] = []
-        for a, b in zip(bounds[:-1], bounds[1:]):
-            if a == b:
-                continue
-            bucket = min(int(np.ceil(max(float(sc[b - 1]), 1.0) / q) * q), n_pad)
-            if groups and groups[-1][1] == bucket:
-                groups[-1][0] += b - a        # merge equal scan lengths
-            else:
-                groups.append([b - a, bucket])
+        chunks = _chunk_buckets(counts[perm], min(c.bucket_groups, len(sampled)),
+                                q, n_pad)
+        groups = [(b - a, bucket) for a, b, bucket in chunks]
         if len(groups) == 1:
             # degenerate schedule: one shared scan length — the single-bucket
             # path computes the identical program (same bucket via
@@ -295,7 +339,7 @@ class FedAvgAPI:
         order; ``pos`` maps each slot back to its original sampled position
         so every client consumes the same per-round RNG key it would under
         the single-bucket program (key = split(rng, cohort)[position])."""
-        local_train = self._local_train
+        cohort_train = self._cohort_train
         finish = self._finish_round
         sizes = [g[0] for g in groups]
         buckets = [g[1] for g in groups]
@@ -313,8 +357,7 @@ class FedAvgAPI:
                 cy = jnp.take(ty, idx_g, axis=0)[:, :bucket]
                 cm = jnp.take(tm, idx_g, axis=0)[:, :bucket]
                 cnt_g = jnp.take(tcounts, idx_g, axis=0) * live[sl]
-                parts.append(jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
-                    variables, cx, cy, cm, cnt_g, keys[sl]))
+                parts.append(cohort_train(variables, cx, cy, cm, cnt_g, keys[sl]))
             res = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
             counts = jnp.take(tcounts, idx, axis=0) * live
             return finish(variables, server_state, res, counts, rng)
@@ -573,7 +616,23 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                 f"effective cohort size ({cohort}) must be a multiple of the "
                 f"mesh 'clients' axis ({n_clients_axis})"
             )
-        self._dev_sharded = self._maybe_place_sharded(cohort)
+        if config.cohort_vmap_width > 0:
+            # the mesh round programs vmap each device's client block inside
+            # shard_map; the chunked schedule applies to the simulation
+            # paradigm only (and measured FLAT there — mfu_experiments H4)
+            log.warning(
+                "cohort_vmap_width=%d ignored: the cross-silo mesh round "
+                "always vmaps the per-device client block",
+                config.cohort_vmap_width)
+        self._dev_sharded = self._dev_groups = self._group_plan = None
+        plan = self._mesh_group_plan(cohort)
+        if plan is not None:
+            self._dev_groups = self._place_grouped(plan)
+            if self._dev_groups is not None:
+                self._group_plan = plan
+                self._grouped_step = self.build_round_step_grouped(len(plan))
+        if self._dev_groups is None:
+            self._dev_sharded = self._maybe_place_sharded(cohort)
 
     def _maybe_place_sharded(self, cohort: int):
         """Full-participation cross-silo (the standard silo deployment:
@@ -605,7 +664,101 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
              np.asarray(ds.train_counts, np.float32)),
         )
 
+    def _mesh_group_plan(self, cohort: int):
+        """Static grouped schedule for the resident-sharded full-participation
+        path — the mesh form of ``_round_groups``. Count-sorted clients are
+        dealt to devices in STRIPS (strip s = clients [sD, (s+1)D), one per
+        device), so strip scan lengths are global constants and the SPMD
+        program is identical on every device; consecutive strips are chunked
+        into at most ``bucket_groups`` groups whose scan length is the chunk's
+        quantum-rounded max count. Returns None (schedule off / nothing to
+        trim) or a tuple of (idx_g, scan_len_g): ``idx_g`` lists the group's
+        client indices DEVICE-MAJOR (shard d of the stacked group axis =
+        that device's strip slots)."""
+        c = self.config
+        ds = self.dataset
+        if c.device_data == "off" or cohort != ds.num_clients:
+            return None
+        D = self.mesh.shape["clients"]
+        L = ds.num_clients // D           # clients per device
+        if c.bucket_groups <= 1 or L < 2:
+            return None
+        n_pad = int(ds.train_x.shape[1])
+        q = c.bucket_quantum_batches * c.batch_size
+        if c.bucket_quantum_batches <= 0 or q >= n_pad:
+            return None
+        counts = np.asarray(ds.train_counts, np.float64)
+        strips = np.argsort(counts, kind="stable").reshape(L, D)
+        strip_max = counts[strips].max(axis=1)      # nondecreasing
+        merged = _chunk_buckets(strip_max, min(c.bucket_groups, L), q, n_pad)
+        if len(merged) == 1 and merged[0][2] >= n_pad:
+            return None                             # nothing to trim
+        return tuple((strips[a:b].T.reshape(-1), bucket) for a, b, bucket in merged)
+
+    def _place_grouped(self, plan):
+        """Resident placement for the grouped schedule: per group, the
+        stacked client arrays are gathered in plan order, TRUNCATED to the
+        group's scan length on host (saving the HBM the padding tail would
+        occupy), and sharded over the mesh. Returns (groups, counts) tuples
+        or None when the dataset is ineligible for residency."""
+        ds = self.dataset
+        n_slots = ds.num_clients * int(ds.train_x.shape[1])
+        kept = sum(len(idx_g) * bucket for idx_g, bucket in plan)
+        x = self._eligible_device_train_x(
+            shard_factor=self.mesh.shape["clients"],
+            slots_fraction=kept / max(n_slots, 1))
+        if x is None:
+            return None
+        from fedml_tpu.parallel.mesh import shard_client_batch
+
+        groups, counts = [], []
+        for idx_g, bucket in plan:
+            gx = x[idx_g][:, :bucket]
+            gy = np.asarray(ds.train_y)[idx_g][:, :bucket]
+            gm = np.asarray(ds.train_mask)[idx_g][:, :bucket]
+            placed = shard_client_batch(self.mesh, (
+                gx, gy, gm, np.asarray(ds.train_counts, np.float32)[idx_g]))
+            groups.append(placed[:3])
+            counts.append(placed[3])
+        return tuple(groups), tuple(counts)
+
+    def build_round_step_grouped(self, n_groups: int):
+        from fedml_tpu.parallel.crosssilo import make_crosssilo_round_grouped
+        from fedml_tpu.parallel.mesh import client_sharded, replicated
+
+        round_fn = make_crosssilo_round_grouped(
+            self._local_train, self.mesh, n_groups,
+            **self._crosssilo_hooks_checked())
+        rep, sh = replicated(self.mesh), client_sharded(self.mesh)
+
+        def round_step(variables, server_state, groups, counts, rng):
+            # every client keeps the per-round key of its ORIGINAL index, so
+            # the grouped schedule changes only the padding steps a client
+            # burns, never which randomness it consumes
+            keys_full = jax.random.split(rng, self.dataset.num_clients)
+            keys = tuple(jax.device_put(keys_full[idx_g], sh)
+                         for idx_g, _ in self._group_plan)
+            variables = jax.device_put(variables, rep)
+            server_state = jax.device_put(server_state, rep)
+            return round_fn(variables, server_state, groups, counts, keys,
+                            jax.device_put(rng, rep))
+
+        return round_step
+
     def run_round(self, round_idx: int) -> float:
+        if self._dev_groups is not None:
+            groups, counts_res = self._dev_groups
+            live = self._sample_failures(round_idx, self.dataset.num_clients)
+            if live is not None:
+                counts = tuple(
+                    c * jnp.asarray(live[idx_g], jnp.float32)
+                    for c, (idx_g, _) in zip(counts_res, self._group_plan))
+            else:
+                counts = counts_res
+            rk = round_key(self.root_key, round_idx)
+            self.variables, self.server_state, train_loss = self._grouped_step(
+                self.variables, self.server_state, groups, counts, rk)
+            return train_loss if self.config.async_rounds else float(train_loss)
         if self._dev_sharded is None:
             return super().run_round(round_idx)
         cx, cy, cm, counts = self._dev_sharded
@@ -618,10 +771,25 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         )
         return train_loss if self.config.async_rounds else float(train_loss)
 
-    def build_round_step(self):
-        from fedml_tpu.parallel.crosssilo import make_crosssilo_round, place_round_inputs
-        from fedml_tpu.parallel.mesh import replicated
+    def round_counts(self, round_idx: int) -> tuple:
+        """Resident full-participation paths execute their own static
+        schedule (no per-round bucketing), so report exactly that: every
+        client's real records, and per-group size x scan_len (grouped) or
+        cohort x n_pad (plain) executed slots."""
+        if self._dev_groups is None and self._dev_sharded is None:
+            return super().round_counts(round_idx)
+        counts = np.asarray(self.dataset.train_counts, np.float64)
+        live = self._sample_failures(round_idx, self.dataset.num_clients,
+                                     record=False)
+        if live is not None:
+            counts = counts * live
+        if self._group_plan is not None:
+            padded = sum(len(idx_g) * bucket for idx_g, bucket in self._group_plan)
+        else:
+            padded = int(self.dataset.train_x.shape[1]) * self.dataset.num_clients
+        return int(counts.sum()), int(padded)
 
+    def _crosssilo_hooks_checked(self) -> dict:
         hooks = self.crosssilo_hooks()
         if hooks is None:
             if type(self).aggregate is not FedAvgAPI.aggregate:
@@ -632,7 +800,14 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                     "simulation paradigm (FedAvgAPI)."
                 )
             hooks = {}
-        round_fn = make_crosssilo_round(self._local_train, self.mesh, **hooks)
+        return hooks
+
+    def build_round_step(self):
+        from fedml_tpu.parallel.crosssilo import make_crosssilo_round, place_round_inputs
+        from fedml_tpu.parallel.mesh import replicated
+
+        round_fn = make_crosssilo_round(self._local_train, self.mesh,
+                                        **self._crosssilo_hooks_checked())
 
         def round_step(variables, server_state, cx, cy, cm, counts, rng):
             keys = jax.random.split(rng, cx.shape[0])

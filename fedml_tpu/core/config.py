@@ -121,6 +121,16 @@ class FedConfig:
     # overheads) without changing the math — same updates in the same
     # order. Measured on v5e: see docs/mfu_experiments.md.
     scan_unroll: int = 1
+    # Cohort execution schedule: 0 (default) trains the whole sampled cohort
+    # under one vmap — per-client convs fuse into ONE grouped convolution
+    # (feature_group_count = cohort), which XLA's TPU lowering expands
+    # ~cohort-fold (docs/mfu_experiments.md H4). k > 0 instead runs the
+    # cohort as lax.map over chunks of k vmapped clients (k=1 = fully
+    # sequential clients, plain convs). EXACT same per-client math and
+    # aggregate either way — this only reorders independent client programs.
+    # Simulation paradigm only (measured FLAT there, H4); the cross-silo
+    # mesh rounds always vmap the per-device client block and warn if set.
+    cohort_vmap_width: int = 0
 
     # observability
     run_name: str = "fedml_tpu"
@@ -235,6 +245,9 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--bucket_quantum_batches", type=int,
                    default=defaults.bucket_quantum_batches)
     p.add_argument("--bucket_groups", type=int, default=defaults.bucket_groups)
+    p.add_argument("--scan_unroll", type=int, default=defaults.scan_unroll)
+    p.add_argument("--cohort_vmap_width", type=int,
+                   default=defaults.cohort_vmap_width)
     p.add_argument("--run_name", type=str, default=defaults.run_name)
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)

@@ -172,13 +172,12 @@ class FedAVGTrainer:
     def __init__(self, dataset, bundle, config):
         self.dataset = dataset
         self.config = config
+        from fedml_tpu.parallel.local import local_train_kwargs
+
         self.local_train = jax.jit(
             make_local_train_fn(
                 bundle, get_task(dataset.task, dataset.class_num),
-                optimizer=config.client_optimizer, lr=config.lr,
-                momentum=config.momentum, wd=config.wd,
-                epochs=config.epochs, batch_size=config.batch_size,
-                grad_clip=config.grad_clip,
+                **local_train_kwargs(config),
             )
         )
         self.client_indices: list[int] = []

@@ -170,12 +170,11 @@ class TAEdgeClientManager(ClientManager):
         # lands (the server's per-rank sends race with peers' sends); such
         # messages are buffered and replayed right after the SYNC
         self._ahead: list[tuple] = []
+        from fedml_tpu.parallel.local import local_train_kwargs
+
         self.local_train = jax.jit(make_local_train_fn(
             bundle, get_task(dataset.task, dataset.class_num),
-            optimizer=config.client_optimizer, lr=config.lr,
-            momentum=config.momentum, wd=config.wd,
-            epochs=config.epochs, batch_size=config.batch_size,
-            grad_clip=config.grad_clip,
+            **local_train_kwargs(config),
         ))
         self._reset_round()
 

@@ -82,6 +82,8 @@ def make_crosssilo_round(
     server_state / rng are replicated.
     """
 
+    finish = _make_mesh_finish(axis, client_transform, reduce_extras, server_update)
+
     def shard_fn(variables, server_state, cx, cy, cm, counts, keys, rng):
         variables0 = variables  # replicated original (all-failed fallback)
         # Mark the replicated global weights as device-varying before local
@@ -94,6 +96,25 @@ def make_crosssilo_round(
         res: LocalResult = jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
             variables, cx, cy, cm, counts, keys
         )
+        return finish(variables0, variables, server_state, res, counts, rng)
+
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(mapped)
+
+
+def _make_mesh_finish(axis, client_transform, reduce_extras, server_update):
+    """The shared post-local-training tail of a mesh round: per-client hook →
+    weighted psum mean → extra reductions → loss → server hook → elastic
+    all-failed rollback. One definition so the plain and grouped round
+    programs cannot drift (``variables`` is the pcast device-varying copy the
+    local training consumed; ``variables0`` the replicated original)."""
+
+    def finish(variables0, variables, server_state, res: LocalResult, counts, rng):
         stacked = res.variables
         if client_transform is not None:
             stacked = client_transform(variables, stacked)
@@ -124,10 +145,59 @@ def make_crosssilo_round(
         new_state = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_state, server_state)
         return new_vars, new_state, loss
 
+    return finish
+
+
+def make_crosssilo_round_grouped(
+    local_train: Callable,
+    mesh: Mesh,
+    n_groups: int,
+    axis: str = "clients",
+    client_transform: Callable | None = None,
+    reduce_extras: Callable | None = None,
+    server_update: Callable | None = None,
+):
+    """Grouped cross-silo round: the mesh counterpart of the simulation
+    paradigm's ``bucket_groups`` schedule (algorithms/fedavg.py
+    build_round_step_gather_groups). Clients are dealt to devices so that
+    every device's group ``g`` shares ONE static scan length (see
+    CrossSiloFedAvgAPI._mesh_group_plan); the round program then runs one
+    vmapped local-training scan per group — small clients stop burning the
+    biggest client's masked padding steps — and ONE psum tail aggregates all
+    groups together. SPMD-safe by construction: group sizes and scan lengths
+    are trace-time constants identical on every device.
+
+    Returns round_fn(variables, server_state, groups, counts, keys, rng)
+    -> (variables, server_state, loss) where ``groups`` is a tuple over g of
+    (cx, cy, cm) stacked [n_g, len_g, ...] sharded along ``axis`` (len_g is
+    the group's truncated record axis), ``counts``/``keys`` matching tuples
+    of [n_g] arrays, and variables/server_state/rng are replicated.
+    """
+    finish = _make_mesh_finish(axis, client_transform, reduce_extras, server_update)
+
+    def shard_fn(variables, server_state, groups, counts, keys, rng):
+        variables0 = variables
+        variables = jax.tree.map(
+            lambda x: jax.lax.pcast(x, axis_name=axis, to="varying"), variables
+        )
+        parts = [
+            jax.vmap(local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+                variables, cx, cy, cm, cnt, k
+            )
+            for (cx, cy, cm), cnt, k in zip(groups, counts, keys)
+        ]
+        # group order is irrelevant to the weighted mean; concatenate the
+        # per-group cohorts back into one stacked axis for the shared tail
+        res = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+        counts_all = jnp.concatenate(counts, axis=0)
+        return finish(variables0, variables, server_state, res, counts_all, rng)
+
+    g_spec = tuple((P(axis), P(axis), P(axis)) for _ in range(n_groups))
+    v_spec = tuple(P(axis) for _ in range(n_groups))
     mapped = shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        in_specs=(P(), P(), g_spec, v_spec, v_spec, P()),
         out_specs=(P(), P(), P()),
     )
     return jax.jit(mapped)

@@ -57,6 +57,22 @@ def make_optimizer(
     return optax.chain(*chain)
 
 
+def local_train_kwargs(config) -> dict:
+    """The ONE config -> make_local_train_fn kwargs mapping. Every consumer
+    of make_local_train_fn (the algorithm APIs via
+    FedAvgAPI._local_train_kwargs, the edge trainers, the centralized
+    baseline) goes through here so a new config knob cannot be silently
+    dropped by one call site."""
+    return dict(
+        optimizer=config.client_optimizer, lr=config.lr,
+        momentum=config.momentum, wd=config.wd,
+        epochs=config.epochs, batch_size=config.batch_size,
+        grad_clip=config.grad_clip,
+        compute_dtype=jnp.bfloat16 if config.dtype == "bfloat16" else None,
+        scan_unroll=config.scan_unroll,
+    )
+
+
 class LocalResult(NamedTuple):
     variables: dict       # updated model variables (params [+ batch_stats])
     train_loss: jax.Array  # mean loss over the last epoch

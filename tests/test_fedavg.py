@@ -439,3 +439,37 @@ def test_scan_unroll_is_exact():
                     jax.tree.leaves(unrolled.variables)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_cohort_vmap_width_is_exact():
+    """cohort_vmap_width only reorders independent client programs (lax.map
+    over vmapped chunks vs one full vmap): per-round losses and final
+    variables must match the full-vmap schedule."""
+    import jax
+
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.models import create_model
+
+    ds = make_synthetic_classification(
+        "cohortw", (10,), 3, 8, records_per_client=21,
+        partition_method="hetero", partition_alpha=0.5, batch_size=4, seed=2)
+
+    def run(width):
+        cfg = FedConfig(model="lr", client_num_in_total=8,
+                        client_num_per_round=8, comm_round=2, epochs=1,
+                        batch_size=4, lr=0.2, momentum=0.9, seed=3,
+                        frequency_of_the_test=100, cohort_vmap_width=width,
+                        device_data="off")
+        api = FedAvgAPI(ds, cfg, create_model("lr", ds.class_num,
+                                              input_shape=(10,)))
+        losses = [float(api.run_round(r)) for r in range(2)]
+        return api, losses
+
+    base, l0 = run(0)
+    for width in (1, 2):
+        chunked, lw = run(width)
+        assert l0 == pytest.approx(lw, rel=1e-6), width
+        for a, b in zip(jax.tree.leaves(base.variables),
+                        jax.tree.leaves(chunked.variables)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-6, atol=1e-7)
