@@ -713,9 +713,11 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
 
         groups, counts = [], []
         for idx_g, bucket in plan:
-            gx = x[idx_g][:, :bucket]
-            gy = np.asarray(ds.train_y)[idx_g][:, :bucket]
-            gm = np.asarray(ds.train_mask)[idx_g][:, :bucket]
+            # single-step fancy index: produce ONLY the truncated copy
+            # (x[idx_g][:, :bucket] would materialize full padded rows first)
+            gx = x[idx_g, :bucket]
+            gy = np.asarray(ds.train_y)[idx_g, :bucket]
+            gm = np.asarray(ds.train_mask)[idx_g, :bucket]
             placed = shard_client_batch(self.mesh, (
                 gx, gy, gm, np.asarray(ds.train_counts, np.float32)[idx_g]))
             groups.append(placed[:3])

@@ -22,8 +22,14 @@ class Observer(abc.ABC):
 
 
 class BaseCommunicationManager(abc.ABC):
-    def __init__(self) -> None:
+    #: wire codec applied to pytree payloads on SEND (core/compression.py:
+    #: raw | q8 | topk:<ratio>). Receivers decode any codec — frames are
+    #: self-describing — so the two sides of a link may differ.
+    codec: str = "raw"
+
+    def __init__(self, codec: str = "raw") -> None:
         self._observers: List[Observer] = []
+        self.codec = codec
 
     @abc.abstractmethod
     def send_message(self, msg: Message) -> None:

@@ -65,8 +65,9 @@ class GRPCCommManager(BaseCommunicationManager):
         ip_config_path: Optional[str] = None,
         base_port: int = BASE_PORT,
         host: str = "0.0.0.0",
+        codec: str = "raw",
     ):
-        super().__init__()
+        super().__init__(codec=codec)
         self.rank = int(rank)
         self.size = int(size)
         self.base_port = int(base_port)
@@ -128,7 +129,7 @@ class GRPCCommManager(BaseCommunicationManager):
             return self._stubs[receiver]
 
     def send_message(self, msg: Message) -> None:
-        self._stub_for(int(msg.get_receiver_id()))(msg.to_bytes())
+        self._stub_for(int(msg.get_receiver_id()))(msg.to_bytes(msg.codec or self.codec))
 
     # -- receive loop ------------------------------------------------------
     def handle_receive_message(self) -> None:

@@ -42,17 +42,21 @@ class LocalRouter:
 
 
 class LocalCommunicationManager(BaseCommunicationManager):
-    def __init__(self, router: LocalRouter, rank: int, wire_roundtrip: bool = False):
-        super().__init__()
+    def __init__(self, router: LocalRouter, rank: int, wire_roundtrip: bool = False,
+                 codec: str = "raw"):
+        super().__init__(codec=codec)
         self.router = router
         self.rank = int(rank)
         self._running = False
         # When set, every message is serialized+deserialized in transit —
         # tests use this to exercise the exact bytes a gRPC hop would carry.
-        self.wire_roundtrip = wire_roundtrip
+        # A non-raw codec forces the roundtrip (compression must actually
+        # apply in-process exactly as it would on a real wire).
+        self.wire_roundtrip = wire_roundtrip or codec != "raw"
 
     def send_message(self, msg: Message) -> None:
-        payload = Message.from_bytes(msg.to_bytes()) if self.wire_roundtrip else msg
+        payload = (Message.from_bytes(msg.to_bytes(msg.codec or self.codec))
+                   if self.wire_roundtrip else msg)
         self.router.post(msg.get_receiver_id(), payload)
 
     def handle_receive_message(self) -> None:
@@ -69,7 +73,7 @@ class LocalCommunicationManager(BaseCommunicationManager):
 
 
 def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
-              timeout: float = 300.0, comm_factory=None):
+              timeout: float = 300.0, comm_factory=None, codec: str = "raw"):
     """Launch ``size`` ranks on threads; rank r runs make_manager(r, comm).
 
     ``make_manager`` returns an object with ``.run()`` (typically a
@@ -80,6 +84,8 @@ def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
     ``comm_factory(rank) -> BaseCommunicationManager`` substitutes a real
     transport (e.g. gRPC loopback) for the in-process router; the default
     builds LocalCommunicationManagers over one shared LocalRouter.
+    ``codec`` sets the default transport's wire codec (compression); a
+    comm_factory configures its own backends.
     """
     router = None if comm_factory else LocalRouter(size)
     comms: list[BaseCommunicationManager] = []
@@ -87,7 +93,9 @@ def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
         for r in range(size):
             comms.append(
                 comm_factory(r) if comm_factory
-                else LocalCommunicationManager(router, r, wire_roundtrip=wire_roundtrip))
+                else LocalCommunicationManager(router, r,
+                                               wire_roundtrip=wire_roundtrip,
+                                               codec=codec))
         managers = [make_manager(r, comms[r]) for r in range(size)]
     except BaseException:
         # partial setup (e.g. a gRPC port already bound): release what was

@@ -44,6 +44,11 @@ class Message:
     MSG_ARG_KEY_NUM_SAMPLES = MSG_ARG_KEY_NUM_SAMPLES
     MSG_ARG_KEY_CLIENT_INDEX = MSG_ARG_KEY_CLIENT_INDEX
 
+    #: per-message codec override (None = use the transport's default).
+    #: Protocols set this when one direction must not share the link codec —
+    #: e.g. full-weight downlinks ride raw while topk compresses delta uplinks.
+    codec: "str | None" = None
+
     def __init__(self, msg_type: int | str = 0, sender_id: int = 0, receiver_id: int = 0):
         self.msg_params: Dict[str, Any] = {
             MSG_ARG_KEY_TYPE: msg_type,
@@ -90,8 +95,12 @@ class Message:
     # -- wire format -------------------------------------------------------
     # frame_pack layout; pytree/array values are replaced in the header by
     # {"__blob__": i} and appended as serialized buffers; JSON-native values
-    # stay inline.
-    def to_bytes(self) -> bytes:
+    # stay inline. ``codec`` (core/compression.py: raw | q8 | topk:<ratio>)
+    # optionally compresses the blobs; frames are self-describing, so a
+    # receiver decodes raw and compressed blobs interchangeably.
+    def to_bytes(self, codec: str = "raw") -> bytes:
+        from fedml_tpu.core.compression import encode_tree
+
         header: Dict[str, Any] = {}
         blobs: list[bytes] = []
         for k, v in self.msg_params.items():
@@ -99,11 +108,14 @@ class Message:
                 header[k] = v
             else:
                 header[k] = {"__blob__": len(blobs)}
-                blobs.append(tree_to_bytes(v))
+                blobs.append(tree_to_bytes(v) if codec == "raw"
+                             else encode_tree(v, codec))
         return frame_pack(_MAGIC, {"h": header, "lens": [len(b) for b in blobs]}, *blobs)
 
     @classmethod
     def from_bytes(cls, buf: bytes) -> "Message":
+        from fedml_tpu.core.compression import decode_tree, is_compressed_frame
+
         meta, off = frame_unpack(_MAGIC, buf)
         blobs = []
         for n in meta["lens"]:
@@ -113,7 +125,9 @@ class Message:
         params: Dict[str, Any] = {}
         for k, v in meta["h"].items():
             if isinstance(v, dict) and set(v) == {"__blob__"}:
-                params[k] = tree_from_bytes(blobs[v["__blob__"]])
+                blob = blobs[v["__blob__"]]
+                params[k] = (decode_tree(blob) if is_compressed_frame(blob)
+                             else tree_from_bytes(blob))
             else:
                 params[k] = v
         msg.msg_params = params

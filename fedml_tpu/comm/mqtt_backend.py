@@ -28,13 +28,14 @@ _STOP = object()
 
 
 class MqttCommManager(BaseCommunicationManager):
-    def __init__(self, host: str, port: int, client_id: int, client_num: int, topic: str = "fedml"):
+    def __init__(self, host: str, port: int, client_id: int, client_num: int,
+                 topic: str = "fedml", codec: str = "raw"):
         if not HAS_PAHO:
             raise ImportError(
                 "paho-mqtt is not installed in this environment; use the gRPC "
                 "or LOCAL backend (fedml_tpu.comm.create_comm_manager)."
             )
-        super().__init__()
+        super().__init__(codec=codec)
         self.client_id = int(client_id)
         self.client_num = int(client_num)
         self.topic = topic
@@ -72,7 +73,7 @@ class MqttCommManager(BaseCommunicationManager):
                 "MQTT backend supports star (client<->server) routing only; "
                 "peer-to-peer algorithms need the LOCAL or gRPC backend"
             )
-        self._client.publish(topic, payload=msg.to_bytes())
+        self._client.publish(topic, payload=msg.to_bytes(msg.codec or self.codec))
 
     def handle_receive_message(self) -> None:
         self._running = True

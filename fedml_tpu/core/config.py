@@ -75,6 +75,17 @@ class FedConfig:
 
     # runtime / backend
     backend: str = "mesh"            # mesh | inproc | grpc | mqtt (reference: MPI|GRPC|MQTT)
+    # Edge-transport payload compression (core/compression.py):
+    # "raw" (exact) | "q8" (uint8 affine quantization, ~4x smaller) |
+    # "topk:<ratio>" (magnitude sparsification — for update deltas).
+    # The reference's --is_mobile JSON-list path is the counterpart
+    # (fedavg/utils.py:7-16) — it converts format without saving bytes.
+    wire_codec: str = "raw"
+    # Edge FedAvg uploads (local - global) deltas with an error-feedback
+    # residual instead of full weights (DGC-style). Lossless under
+    # wire_codec="raw"; pairs with "topk:<r>"/"q8", whose un-sent mass
+    # re-enters the next round's upload.
+    wire_delta: bool = False
     frequency_of_the_test: int = 5
     is_mobile: int = 0
     seed: int = 0
@@ -169,6 +180,15 @@ class FedConfig:
             raise ValueError(
                 f"failure_prob must be in [0, 1), got {self.failure_prob}"
             )
+        from fedml_tpu.core.compression import parse_codec
+
+        parse_codec(self.wire_codec)   # raises on an unknown codec spec
+        if self.wire_codec.startswith("topk") and not self.wire_delta:
+            raise ValueError(
+                "wire_codec='topk:..' sparsifies uploads destructively unless "
+                "they are error-feedback deltas; set wire_delta=True (q8 and "
+                "raw work with either mode)"
+            )
         if self.ci:
             # CI fast path: shrink everything (reference fedavg_api.py:157-162).
             self.comm_round = min(self.comm_round, 2)
@@ -248,6 +268,11 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--scan_unroll", type=int, default=defaults.scan_unroll)
     p.add_argument("--cohort_vmap_width", type=int,
                    default=defaults.cohort_vmap_width)
+    p.add_argument("--wire_codec", type=str, default=defaults.wire_codec,
+                   help="edge payload compression: raw | q8 | topk:<ratio>")
+    p.add_argument("--wire_delta", type=lambda s: bool(int(s)),
+                   default=defaults.wire_delta,
+                   help="edge FedAvg uploads error-feedback deltas (0|1)")
     p.add_argument("--run_name", type=str, default=defaults.run_name)
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)

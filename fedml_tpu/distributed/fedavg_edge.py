@@ -42,6 +42,12 @@ MSG_TYPE_S2C_SYNC_MODEL = 2
 MSG_TYPE_C2S_SEND_MODEL = 3
 MSG_TYPE_S2C_FINISH = 4
 
+# Extension beyond the reference protocol: with config.wire_delta the client
+# uploads (local mean - global) + error-feedback residual under this key
+# instead of full weights, so a lossy wire codec (q8 / topk) compresses a
+# small-magnitude tensor and the un-sent mass re-enters next round.
+MSG_ARG_KEY_MODEL_DELTA = "model_delta"
+
 
 class FedAVGAggregator:
     """Server-side state: collect worker results, weighted-average, sample.
@@ -124,11 +130,20 @@ class FedAvgEdgeServerManager(ServerManager):
         workers = self.size - 1
         return [[int(c) for c in sampled[w::workers]] for w in range(workers)]
 
+    def _downlink_codec(self):
+        """topk is an UPLOAD (delta) compressor; sparsifying the full-weight
+        downlink would destroy the model, so sync messages override it to
+        raw. q8 downlinks are fine (and the delta reconstruction accounts
+        for them)."""
+        codec = getattr(self.aggregator.config, "wire_codec", "raw")
+        return "raw" if codec.startswith("topk") else None
+
     def send_init_msg(self):
         assignments = self._assignments(0)
         global_params = self.aggregator.get_global_model_params()
         for rank in range(1, self.size):
             m = Message(MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
+            m.codec = self._downlink_codec()
             m.add_params(MSG_ARG_KEY_MODEL_PARAMS, global_params)
             m.add_params(MSG_ARG_KEY_CLIENT_INDEX, assignments[rank - 1])
             self.send_message(m)
@@ -140,8 +155,30 @@ class FedAvgEdgeServerManager(ServerManager):
 
     def handle_message_receive_model_from_client(self, msg: Message):
         sender = msg.get_sender_id()
+        payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        if payload is None:
+            # delta upload: reconstruct the worker model against the global
+            # weights this round was trained from (aggregate() has not run
+            # yet, so aggregator.variables still holds them). Under a lossy
+            # codec the client trained from the DECODED downlink, so
+            # reconstruct against that same lossy image — otherwise every
+            # worker model would be off by the downlink compression error,
+            # a bias the client's error-feedback residual never sees.
+            from fedml_tpu.core.compression import decode_tree, encode_tree
+            from fedml_tpu.core.pytree import tree_add
+
+            base = self.aggregator.get_global_model_params()
+            # mirror the DOWNLINK codec (sync messages override topk to raw,
+            # see _downlink_codec — so under topk the client trained from the
+            # exact global weights)
+            codec = getattr(self.aggregator.config, "wire_codec", "raw")
+            if codec != "raw" and not codec.startswith("topk"):
+                base = decode_tree(encode_tree(base, codec))
+            payload = jax.tree.map(
+                np.asarray,
+                tree_add(base, msg.get(MSG_ARG_KEY_MODEL_DELTA)))
         self.aggregator.add_local_trained_result(
-            sender - 1, msg.get(MSG_ARG_KEY_MODEL_PARAMS), msg.get(MSG_ARG_KEY_NUM_SAMPLES)
+            sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
         )
         if not self.aggregator.check_whether_all_receive():
             return
@@ -160,6 +197,7 @@ class FedAvgEdgeServerManager(ServerManager):
         assignments = self._assignments(self.round_idx)
         for rank in range(1, self.size):
             m = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, rank)
+            m.codec = self._downlink_codec()
             m.add_params(MSG_ARG_KEY_MODEL_PARAMS, global_params)
             m.add_params(MSG_ARG_KEY_CLIENT_INDEX, assignments[rank - 1])
             self.send_message(m)
@@ -213,6 +251,9 @@ class FedAvgEdgeClientManager(ClientManager):
         self.trainer = trainer
         self.root_key = root_key
         self.round_idx = 0
+        # error-feedback residual for delta uploads (per WORKER, like DGC:
+        # the stream being compressed is this worker's upload sequence)
+        self._residual = None
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
@@ -237,7 +278,24 @@ class FedAvgEdgeClientManager(ClientManager):
         variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         new_vars, n = self.trainer.train(variables, self.round_idx, self.root_key)
         out = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
-        out.add_params(MSG_ARG_KEY_MODEL_PARAMS, new_vars)
+        cfg = self.trainer.config
+        if getattr(cfg, "wire_delta", False):
+            from fedml_tpu.core.compression import decode_tree, encode_tree
+            from fedml_tpu.core.pytree import tree_add, tree_sub
+
+            d = tree_sub(new_vars, jax.tree.map(np.asarray, variables))
+            if self._residual is not None:
+                d = tree_add(d, self._residual)
+            # simulate the transport's (deterministic) codec so the residual
+            # accounts for exactly what the server will receive; with a raw
+            # codec the residual stays zero and the protocol is lossless
+            codec = getattr(cfg, "wire_codec", "raw")
+            if codec != "raw":
+                received = decode_tree(encode_tree(d, codec))
+                self._residual = tree_sub(d, received)
+            out.add_params(MSG_ARG_KEY_MODEL_DELTA, d)
+        else:
+            out.add_params(MSG_ARG_KEY_MODEL_PARAMS, new_vars)
         out.add_params(MSG_ARG_KEY_NUM_SAMPLES, n)
         self.send_message(out)
 
@@ -273,5 +331,6 @@ def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = Tru
         return FedAvgEdgeClientManager(args, comm, rank, size, trainer, root_key)
 
     run_ranks(make, size, wire_roundtrip=wire_roundtrip,
-              comm_factory=comm_factory)
+              comm_factory=comm_factory,
+              codec=getattr(config, "wire_codec", "raw"))
     return aggregator

@@ -28,7 +28,7 @@ ALGORITHMS = (
     "crosssilo_fedopt", "crosssilo_fednova", "crosssilo_fedagc",
     "crosssilo_fedavg_robust", "crosssilo_fedprox", "crosssilo_decentralized",
     "crosssilo_fedseg", "crosssilo_hierarchical", "crosssilo_fednas",
-    "streaming_fedavg",
+    "streaming_fedavg", "fedavg_edge",
 )
 
 
@@ -98,6 +98,48 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
         return result
 
     ds = _load(config)
+
+    if algorithm == "fedavg_edge":
+        # the message-driven deployment (reference mpirun path): 1 server +
+        # N workers over the in-process router, or real gRPC loopback with
+        # --backend grpc — with optional payload compression (--wire_codec)
+        # and error-feedback delta uploads (--wire_delta)
+        from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+
+        workers = min(config.client_num_per_round, ds.num_clients)
+        if config.backend.lower() == "grpc":
+            import socket
+
+            from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+            # an ephemeral-port probe only suggests a free BLOCK base; the
+            # block can be raced before the ranks bind, so retry with a
+            # fresh base on bind failure (run_ranks tears down partial
+            # setups, so a retry starts clean)
+            last_err = None
+            for _ in range(3):
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    base = s.getsockname()[1]
+                try:
+                    agg = run_fedavg_edge(
+                        ds, config, worker_num=workers,
+                        comm_factory=lambda r: GRPCCommManager(
+                            r, workers + 1, base_port=base, host="127.0.0.1",
+                            codec=config.wire_codec))
+                    break
+                except OSError as e:
+                    last_err = e
+            else:
+                raise last_err
+        else:
+            agg = run_fedavg_edge(ds, config, worker_num=workers)
+        hist = agg.test_history
+        result = {"round": [h["round"] for h in hist],
+                  "Test/Acc": [h["acc"] for h in hist],
+                  "Test/Loss": [h["loss"] for h in hist]}
+        log.info("result %s", json.dumps({"rounds": len(hist)}))
+        return result
 
     if algorithm == "fedgkt":
         from fedml_tpu.algorithms.fedgkt import FedGKTAPI

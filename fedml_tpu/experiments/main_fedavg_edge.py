@@ -1,0 +1,11 @@
+"""Thin alias of the unified launcher (reference fedml_experiments pattern:
+one main per algorithm). Equivalent to --algorithm fedavg_edge — the
+message-driven FedAvg deployment (reference mpirun + FedAvgAPI.py:20-28
+rank branch), over the in-process router or gRPC with --backend grpc."""
+
+import sys
+
+from fedml_tpu.experiments.run import main
+
+if __name__ == "__main__":
+    main(sys.argv[1:], default_algorithm="fedavg_edge")

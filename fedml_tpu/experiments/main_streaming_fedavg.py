@@ -1,0 +1,11 @@
+"""Thin alias of the unified launcher (reference fedml_experiments pattern:
+one main per algorithm). Equivalent to --algorithm streaming_fedavg —
+FedAvg whose clients stream batches from host memory through the native
+ordered pipeline (for datasets exceeding the device-residency budget)."""
+
+import sys
+
+from fedml_tpu.experiments.run import main
+
+if __name__ == "__main__":
+    main(sys.argv[1:], default_algorithm="streaming_fedavg")

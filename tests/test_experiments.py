@@ -101,6 +101,16 @@ def test_dispatcher_covers_crosssilo_structured():
     assert isinstance(out, dict) and out
 
 
+def test_dispatcher_covers_fedavg_edge():
+    """The message-driven deployment is reachable from the launcher, with
+    payload compression + delta uploads on."""
+    out = main(_argv("fedavg_edge", dataset="synthetic_1_1",
+                     client_num_in_total="4", client_num_per_round="2",
+                     batch_size="10", comm_round="2",
+                     wire_codec="q8", wire_delta="1"))
+    assert isinstance(out, dict) and out["Test/Acc"]
+
+
 def test_dispatcher_covers_splitnn():
     out = main(_argv("splitnn", dataset="mnist", model="cnn",
                      client_num_in_total="2", client_num_per_round="2",
@@ -133,7 +143,7 @@ def test_dispatcher_covers_fednas_and_fedseg_and_nothing_is_missed():
         "crosssilo_fednova", "crosssilo_fedagc", "crosssilo_fedavg_robust",
         "crosssilo_fedprox", "crosssilo_decentralized", "crosssilo_fedseg",
         "crosssilo_hierarchical", "crosssilo_fednas", "splitnn", "fednas",
-        "fedseg",
+        "fedseg", "fedavg_edge",
         # dedicated test module: tests/test_streaming_fedavg.py
         "streaming_fedavg",
         # remaining-standalone parametrize
