@@ -242,6 +242,11 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
             alpha_distill=config.alpha_distill,
         )
 
+    # GKT's payloads are the framework's biggest (per-sample feature maps +
+    # logits both ways); the wire codec compresses them — q8 suits the
+    # distillation exchange, whose targets are soft logits anyway. Labels/
+    # masks and any integer arrays ride raw inside lossy frames.
     managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip,
-                         comm_factory=comm_factory)
+                         comm_factory=comm_factory,
+                         codec=getattr(config, "wire_codec", "raw"))
     return managers[0]

@@ -111,6 +111,27 @@ class TestGKTEdge:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        rtol=5e-2, atol=5e-2)
 
+    def test_q8_compressed_exchange(self):
+        """GKT's feature/logit payloads over a q8-compressed wire: the
+        distillation exchange tolerates quantization — results stay close
+        to the raw-wire run (soft-target exchange, not exact weights)."""
+        from fedml_tpu.distributed.fedgkt_edge import run_fedgkt_edge
+
+        ds = _ds()
+        cfg = FedConfig(
+            model="lr", dataset="synthetic", client_num_in_total=4,
+            client_num_per_round=4, comm_round=2, epochs=1, epochs_server=1,
+            batch_size=4, lr=0.05, seed=5, frequency_of_the_test=1,
+            wire_codec="q8",
+        )
+        server = run_fedgkt_edge(ds, cfg, client_blocks=1,
+                                 server_blocks_per_stage=1)
+        _, sim_out, _ = self._run_pair()
+        out = server.history[-1]
+        assert np.isfinite(out["Test/Loss"])
+        np.testing.assert_allclose(out["Test/Acc"], sim_out["Test/Acc"],
+                                   atol=0.11)
+
     def test_grpc_loopback(self):
         import pytest
 

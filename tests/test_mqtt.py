@@ -156,3 +156,21 @@ def test_manager_runtime_over_mqtt(mqtt_env):
 
     managers = run_ranks(make, size, comm_factory=comm_factory)
     assert sorted(managers[0].got) == [(1, 10), (2, 20)]
+
+
+def test_mqtt_codec_applies(mqtt_env):
+    """The MQTT send path honors the backend codec: a q8-configured client's
+    upload arrives quantized (smaller payload, bounded error) and the server
+    decodes it with no out-of-band agreement."""
+    server = mqtt_backend.MqttCommManager("localhost", 1883, client_id=0,
+                                          client_num=1)
+    c1 = mqtt_backend.MqttCommManager("localhost", 1883, client_id=1,
+                                      client_num=1, codec="q8")
+    w = np.linspace(-1.0, 1.0, 256).astype(np.float32).reshape(16, 16)
+    up = Message("up", 1, 0)
+    up.add_params(MSG_ARG_KEY_MODEL_PARAMS, {"w": w})
+    c1.send_message(up)
+    got = server._inbox.get_nowait().get(MSG_ARG_KEY_MODEL_PARAMS)["w"]
+    step = (w.max() - w.min()) / 255.0
+    assert np.max(np.abs(got - w)) <= step / 2 + 1e-6
+    assert not np.array_equal(got, w)  # actually quantized, not raw
