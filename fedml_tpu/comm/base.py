@@ -43,6 +43,13 @@ class BaseCommunicationManager(abc.ABC):
     def stop_receive_message(self) -> None:
         ...
 
+    def inject_local(self, msg: Message) -> None:
+        """Enqueue a message into THIS node's own delivery queue (it never
+        touches the wire). Control events — e.g. a straggler-deadline timer
+        firing — use this so they serialize with real message handling on
+        the receive loop instead of racing it from another thread."""
+        raise NotImplementedError(f"{type(self).__name__} has no local injection")
+
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
 

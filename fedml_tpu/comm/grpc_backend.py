@@ -66,11 +66,16 @@ class GRPCCommManager(BaseCommunicationManager):
         base_port: int = BASE_PORT,
         host: str = "0.0.0.0",
         codec: str = "raw",
+        send_timeout: float = 120.0,
     ):
         super().__init__(codec=codec)
         self.rank = int(rank)
         self.size = int(size)
         self.base_port = int(base_port)
+        # In multi-process deployments ranks start in arbitrary order, so a
+        # send may race the receiver's bind; wait_for_ready blocks the call
+        # until the peer's server is up, bounded by this timeout.
+        self.send_timeout = float(send_timeout)
         if ip_table is None:
             ip_table = build_ip_table(ip_config_path) if ip_config_path else {r: "127.0.0.1" for r in range(size)}
         self.ip_table = ip_table
@@ -129,7 +134,14 @@ class GRPCCommManager(BaseCommunicationManager):
             return self._stubs[receiver]
 
     def send_message(self, msg: Message) -> None:
-        self._stub_for(int(msg.get_receiver_id()))(msg.to_bytes(msg.codec or self.codec))
+        self._stub_for(int(msg.get_receiver_id()))(
+            msg.to_bytes(msg.codec or self.codec),
+            wait_for_ready=True,
+            timeout=self.send_timeout,
+        )
+
+    def inject_local(self, msg: Message) -> None:
+        self._inbox.put(msg)
 
     # -- receive loop ------------------------------------------------------
     def handle_receive_message(self) -> None:

@@ -59,6 +59,9 @@ class LocalCommunicationManager(BaseCommunicationManager):
                    if self.wire_roundtrip else msg)
         self.router.post(msg.get_receiver_id(), payload)
 
+    def inject_local(self, msg: Message) -> None:
+        self.router.post(self.rank, msg)
+
     def handle_receive_message(self) -> None:
         self._running = True
         while self._running:

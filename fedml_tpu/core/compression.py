@@ -85,7 +85,9 @@ def _decode_leaf(meta: dict, buf: bytes) -> np.ndarray:
     dtype = np.dtype(meta["dtype"])
     enc = meta["enc"]
     if enc == "raw":
-        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+        # copy: frombuffer returns a read-only view that pins the whole
+        # frame (all blobs) alive and breaks in-place consumers
+        return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
     if enc == "q8":
         q = np.frombuffer(buf, dtype=np.uint8).astype(np.float32)
         x = meta["lo"] + q * meta["scale"]

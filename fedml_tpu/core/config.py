@@ -75,6 +75,17 @@ class FedConfig:
 
     # runtime / backend
     backend: str = "mesh"            # mesh | inproc | grpc | mqtt (reference: MPI|GRPC|MQTT)
+    # Multi-process deployment (reference: mpirun -np N, run_fedavg_
+    # distributed_pytorch.sh:21-23 — one OS process per participant). When
+    # rank is set, the entry point starts ONLY this rank's manager over a
+    # real transport (gRPC, rank→IP resolved from grpc_ipconfig_path like
+    # the reference's grpc_ipconfig.csv, grpc_comm_manager.py:59-60) and
+    # blocks until the federation finishes. rank=None (default) keeps the
+    # single-process in-memory launch used by simulations and tests.
+    rank: Optional[int] = None
+    world_size: Optional[int] = None
+    grpc_ipconfig_path: Optional[str] = None  # csv "receiver_id,ip"; None = all loopback
+    grpc_base_port: int = 50000      # reference: port 50000 + rank
     # Edge-transport payload compression (core/compression.py):
     # "raw" (exact) | "q8" (uint8 affine quantization, ~4x smaller) |
     # "topk:<ratio>" (magnitude sparsification — for update deltas).
@@ -154,6 +165,15 @@ class FedConfig:
 
     # failure injection / elastic rounds (SURVEY.md §5.3: reference has none)
     failure_prob: float = 0.0        # P(sampled client fails a round)
+    # Fault-tolerant EDGE rounds (reference: one dead worker hangs the
+    # federation until MPI.Abort, client_manager.py:66-69; the mesh path
+    # here already has elastic rounds). When set, the edge server
+    # aggregates whichever uploads arrived within this many seconds of a
+    # round's broadcast, marks missing workers dead (skipping their sends
+    # so a dead peer can't stall the loop), re-deals their logical clients
+    # to survivors next round, and accepts rejoining workers. None (default)
+    # keeps the strict all-workers barrier.
+    straggler_deadline_sec: Optional[float] = None
 
     # jax profiler (SURVEY.md §5.1): device traces for TensorBoard
     profile_dir: Optional[str] = None
@@ -180,6 +200,15 @@ class FedConfig:
             raise ValueError(
                 f"failure_prob must be in [0, 1), got {self.failure_prob}"
             )
+        if self.rank is not None:
+            if self.world_size is None or self.world_size < 2:
+                raise ValueError(
+                    "--rank requires --world_size >= 2 (1 server + >=1 worker)"
+                )
+            if not 0 <= self.rank < self.world_size:
+                raise ValueError(
+                    f"rank {self.rank} out of range for world_size {self.world_size}"
+                )
         from fedml_tpu.core.compression import parse_codec
 
         parse_codec(self.wire_codec)   # raises on an unknown codec spec
@@ -253,6 +282,13 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--model_server", type=str, default=defaults.model_server)
     p.add_argument("--epochs_server", type=int, default=defaults.epochs_server)
     p.add_argument("--backend", type=str, default=defaults.backend)
+    p.add_argument("--rank", type=int, default=None,
+                   help="start ONLY this rank as its own OS process (0=server)")
+    p.add_argument("--world_size", type=int, default=None,
+                   help="total ranks (1 server + N workers) for --rank mode")
+    p.add_argument("--grpc_ipconfig_path", type=str, default=None,
+                   help="rank->IP csv (reference grpc_ipconfig.csv); default loopback")
+    p.add_argument("--grpc_base_port", type=int, default=defaults.grpc_base_port)
     p.add_argument("--frequency_of_the_test", type=int, default=defaults.frequency_of_the_test)
     p.add_argument("--is_mobile", type=int, default=defaults.is_mobile)
     p.add_argument("--seed", type=int, default=defaults.seed)
@@ -278,6 +314,9 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)
     p.add_argument("--resume_from", type=str, default=None)
     p.add_argument("--failure_prob", type=float, default=defaults.failure_prob)
+    p.add_argument("--straggler_deadline_sec", type=float, default=None,
+                   help="edge rounds: aggregate the received subset after "
+                        "this many seconds instead of waiting forever")
     p.add_argument("--profile_dir", type=str, default=None)
     p.add_argument("--config_yaml", type=str, default=None, help="optional YAML overriding flags")
     return p

@@ -16,12 +16,18 @@ re-binding, exactly like the reference's client-sampling concurrency model
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional
 
 import jax
 import numpy as np
 
-from fedml_tpu.comm import ClientManager, Message, ServerManager
+from fedml_tpu.comm import (
+    BaseCommunicationManager,
+    ClientManager,
+    Message,
+    ServerManager,
+)
 from fedml_tpu.comm.local import run_ranks
 from fedml_tpu.comm.message import (
     MSG_ARG_KEY_CLIENT_INDEX,
@@ -41,6 +47,22 @@ MSG_TYPE_S2C_INIT_CONFIG = 1
 MSG_TYPE_S2C_SYNC_MODEL = 2
 MSG_TYPE_C2S_SEND_MODEL = 3
 MSG_TYPE_S2C_FINISH = 4
+# Beyond the reference protocol (its only failure story is MPI.Abort,
+# client_manager.py:66-69): a worker announces itself so a restarted /
+# reconnected process can re-enter a running federation.
+MSG_TYPE_C2S_JOIN = 5
+# Control event injected into the server's OWN queue when the straggler
+# deadline fires — never crosses the wire.
+MSG_TYPE_LOCAL_ROUND_DEADLINE = 99
+# Round tag: syncs carry the server's round index; uploads echo it so the
+# server can drop stale uploads from workers that fell behind and rejoined.
+MSG_ARG_KEY_ROUND = "round_idx"
+# Broadcast generation: bumped on every model broadcast, echoed by uploads.
+# Distinguishes pre- vs post-re-deal uploads of the SAME round (an all-fail
+# round re-broadcasts round N with the lost clients re-dealt; a slow
+# worker's original round-N upload must not be aggregated alongside the
+# re-dealt copy of the same clients — the round tag alone can't tell).
+MSG_ARG_KEY_GEN = "bcast_gen"
 
 # Extension beyond the reference protocol: with config.wire_delta the client
 # uploads (local mean - global) + error-feedback residual under this key
@@ -85,8 +107,14 @@ class FedAVGAggregator:
 
     def aggregate(self):
         order = sorted(self.model_dict)
-        stacked = jax.tree.map(lambda *xs: np.stack(xs), *[self.model_dict[i] for i in order])
         counts = np.asarray([self.sample_num_dict[i] for i in order], np.float32)
+        if not order or float(counts.sum()) <= 0.0:
+            # zero-weight round (e.g. only rejoin catch-up uploads after an
+            # all-fail round): keep the model — the elastic no-op, matching
+            # the mesh path's all-fail behavior (tests/test_failures.py)
+            self.model_dict.clear()
+            return self.variables
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *[self.model_dict[i] for i in order])
         self.variables = tree_weighted_mean(stacked, counts)
         self.model_dict.clear()
         return self.variables
@@ -105,30 +133,138 @@ class FedAVGAggregator:
 
 
 class FedAvgEdgeServerManager(ServerManager):
-    """Reference FedAvgServerManager.py:18-95."""
+    """Reference FedAvgServerManager.py:18-95 — plus fault-tolerant rounds
+    the reference lacks (its only failure handling is MPI.COMM_WORLD.Abort,
+    client_manager.py:66-69): with ``config.straggler_deadline_sec`` set,
+    a round aggregates whichever uploads arrived by the deadline, missing
+    workers are marked dead (their sends skipped so a dead peer can't stall
+    the loop), their logical clients are re-dealt to survivors next round,
+    and a worker that reconnects (JOIN message) re-enters the federation."""
 
     def __init__(self, args, comm, rank, size, aggregator: FedAVGAggregator):
         super().__init__(args, comm, rank, size)
         self.aggregator = aggregator
         self.round_num = int(args.comm_round)
         self.round_idx = 0
+        # The image of the downlink the clients actually trained from this
+        # round (decoded once at send time). Delta uploads reconstruct
+        # against it; caching here keeps the sync path and the
+        # reconstruction path one and the same code, and avoids O(workers)
+        # redundant full-model re-encodes per round.
+        self._downlink_image = None
+        # fault tolerance (None = reference-strict: wait for all workers)
+        self._deadline = getattr(aggregator.config, "straggler_deadline_sec", None)
+        if self._deadline is not None and (
+            type(comm).inject_local is BaseCommunicationManager.inject_local
+        ):
+            raise ValueError(
+                "straggler_deadline_sec needs a transport with local event "
+                f"injection (local/grpc); {type(comm).__name__} has none"
+            )
+        self._alive = {w: True for w in range(size - 1)}
+        self._lost_clients: list[int] = []
+        self._assignment_map: dict[int, list[int]] = {}
+        self._expected: set[int] = set(range(size - 1))
+        self._timer: Optional[threading.Timer] = None
+        self._bcast_gen = 0
+        # consecutive deadlines with zero uploads AND zero alive workers;
+        # at _MAX_EMPTY_DEADLINES the federation tears down instead of
+        # waiting forever for a rejoin that may never come
+        self._empty_deadlines = 0
+
+    _MAX_EMPTY_DEADLINES = 10
 
     def run(self):
         self.register_message_receive_handlers()
         self.send_init_msg()
         self.com_manager.handle_receive_message()
 
-    def _assignments(self, round_idx: int) -> list[list[int]]:
+    def _assignments(self, round_idx: int) -> dict[int, list[int]]:
         """Sample client_num_per_round logical clients and deal them to the
-        size-1 workers round-robin — the reference's worker/logical-client
+        alive workers round-robin — the reference's worker/logical-client
         re-binding (FedAvgClientManager.py:50-61) generalized to
-        cohort != worker_num."""
+        cohort != worker_num. Logical clients lost to a dead worker last
+        round are dealt first, so no sampled client silently drops out."""
         cohort = min(self.args.client_num_per_round, self.args.client_num_in_total)
-        sampled = self.aggregator.client_sampling(
+        sampled = [int(c) for c in self.aggregator.client_sampling(
             round_idx, self.args.client_num_in_total, cohort
-        )
-        workers = self.size - 1
-        return [[int(c) for c in sampled[w::workers]] for w in range(workers)]
+        )]
+        if self._lost_clients:
+            sampled = [c for c in self._lost_clients if c not in sampled] + sampled
+            self._lost_clients = []
+        out: dict[int, list[int]] = {w: [] for w in range(self.size - 1)}
+        targets = [w for w in out if self._alive[w]]
+        if not targets:
+            self._lost_clients = sampled   # nobody to run them; carry over
+            return out
+        for i, c in enumerate(sampled):
+            out[targets[i % len(targets)]].append(c)
+        return out
+
+    # -- fault tolerance ---------------------------------------------------
+    def _mark_dead(self, w: int) -> None:
+        if self._alive.get(w, False):
+            self._alive[w] = False
+            lost = self._assignment_map.get(w, [])
+            self._lost_clients.extend(c for c in lost if c not in self._lost_clients)
+            LOG.warning("worker %d marked dead; re-dealing clients %s", w, lost)
+        self._expected.discard(w)
+
+    def _arm_timer(self) -> None:
+        if self._deadline is None:
+            return
+        self._cancel_timer()
+        tag = self.round_idx
+
+        def fire():
+            m = Message(MSG_TYPE_LOCAL_ROUND_DEADLINE, self.rank, self.rank)
+            m.add_params(MSG_ARG_KEY_ROUND, tag)
+            try:
+                self.com_manager.inject_local(m)
+            except Exception as e:   # e.g. receive loop already torn down
+                LOG.warning("deadline timer injection failed: %s", e)
+
+        self._timer = threading.Timer(self._deadline, fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _cancel_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def handle_round_deadline(self, msg: Message) -> None:
+        if self._deadline is None or int(msg.get(MSG_ARG_KEY_ROUND)) != self.round_idx:
+            return   # stale timer from a round that completed in time
+        received = set(self.aggregator.model_dict.keys())
+        for w in sorted(self._expected - received):
+            LOG.warning("round %d: worker %d missed the %.1fs deadline",
+                        self.round_idx, w, self._deadline)
+            self._mark_dead(w)
+        if received:
+            self._empty_deadlines = 0
+            self._complete_round()
+        elif any(self._alive.values()):
+            # nobody reported but somebody is alive: re-deal and re-sync the
+            # SAME round (model unchanged — an all-fail no-op, like the mesh
+            # path's elastic all-fail round)
+            self._empty_deadlines = 0
+            self._assignment_map = self._assignments(self.round_idx)
+            self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL,
+                                  self.aggregator.get_global_model_params(),
+                                  self._assignment_map)
+        else:
+            # every worker is dead: wait for a rejoin, bounded
+            self._empty_deadlines += 1
+            if self._empty_deadlines >= self._MAX_EMPTY_DEADLINES:
+                LOG.error(
+                    "round %d: all workers dead for %d consecutive deadlines; "
+                    "tearing the federation down with %d/%d rounds done",
+                    self.round_idx, self._empty_deadlines,
+                    self.round_idx, self.round_num)
+                self._teardown()
+            else:
+                self._arm_timer()
 
     def _downlink_codec(self):
         """topk is an UPLOAD (delta) compressor; sparsifying the full-weight
@@ -138,51 +274,135 @@ class FedAvgEdgeServerManager(ServerManager):
         codec = getattr(self.aggregator.config, "wire_codec", "raw")
         return "raw" if codec.startswith("topk") else None
 
-    def send_init_msg(self):
-        assignments = self._assignments(0)
-        global_params = self.aggregator.get_global_model_params()
-        for rank in range(1, self.size):
-            m = Message(MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
-            m.codec = self._downlink_codec()
+    def _broadcast_model(self, msg_type: int, global_params, assignments):
+        """Send the model to every worker and cache the decoded image the
+        workers will actually train from (delta uploads reconstruct against
+        it — computing it once here keeps sync and reconstruction the same
+        bytes by construction instead of re-encoding per upload)."""
+        override = self._downlink_codec()
+        effective = override if override is not None else getattr(
+            self.aggregator.config, "wire_codec", "raw")
+        if effective != "raw":
+            from fedml_tpu.core.compression import decode_tree, encode_tree
+
+            self._downlink_image = decode_tree(encode_tree(global_params, effective))
+        else:
+            self._downlink_image = global_params
+        self._expected = set()
+        self._bcast_gen += 1
+        for w in sorted(assignments):
+            if not self._alive[w]:
+                continue
+            m = Message(msg_type, self.rank, w + 1)
+            m.codec = override
             m.add_params(MSG_ARG_KEY_MODEL_PARAMS, global_params)
-            m.add_params(MSG_ARG_KEY_CLIENT_INDEX, assignments[rank - 1])
-            self.send_message(m)
+            m.add_params(MSG_ARG_KEY_CLIENT_INDEX, assignments[w])
+            m.add_params(MSG_ARG_KEY_ROUND, self.round_idx)
+            m.add_params(MSG_ARG_KEY_GEN, self._bcast_gen)
+            try:
+                self.send_message(m)
+            except Exception as e:
+                if self._deadline is None:
+                    raise
+                # dead peer: a blocked/failed send must not stall the round
+                LOG.warning("send to worker %d failed (%s)", w, e)
+                self._mark_dead(w)
+                continue
+            self._expected.add(w)
+        self._arm_timer()
+
+    def send_init_msg(self):
+        self._assignment_map = self._assignments(0)
+        self._broadcast_model(MSG_TYPE_S2C_INIT_CONFIG,
+                              self.aggregator.get_global_model_params(),
+                              self._assignment_map)
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
             MSG_TYPE_C2S_SEND_MODEL, self.handle_message_receive_model_from_client
         )
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_JOIN, self.handle_message_join
+        )
+        self.register_message_receive_handler(
+            MSG_TYPE_LOCAL_ROUND_DEADLINE, self.handle_round_deadline
+        )
+
+    def handle_message_join(self, msg: Message) -> None:
+        """A (re)connecting worker announces itself. Already-alive workers'
+        JOINs (every worker sends one at startup in fault-tolerant mode) are
+        ignored — replying would double-book them for the current round. A
+        dead worker is revived and sent the current model with an empty
+        assignment so it can catch up and take real work next round."""
+        if self._deadline is None:
+            return
+        self._empty_deadlines = 0
+        w = msg.get_sender_id() - 1
+        if self._alive.get(w, False):
+            return
+        LOG.info("worker %d rejoined at round %d", w, self.round_idx)
+        self._alive[w] = True
+        m = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, w + 1)
+        m.codec = self._downlink_codec()
+        m.add_params(MSG_ARG_KEY_MODEL_PARAMS,
+                     self.aggregator.get_global_model_params())
+        m.add_params(MSG_ARG_KEY_CLIENT_INDEX, [])
+        m.add_params(MSG_ARG_KEY_ROUND, self.round_idx)
+        # current generation, NOT a bump: the round's outstanding uploads
+        # must stay valid
+        m.add_params(MSG_ARG_KEY_GEN, self._bcast_gen)
+        try:
+            self.send_message(m)
+        except Exception as e:
+            LOG.warning("catch-up send to rejoined worker %d failed (%s)", w, e)
+            self._alive[w] = False
 
     def handle_message_receive_model_from_client(self, msg: Message):
         sender = msg.get_sender_id()
+        if self._deadline is not None:
+            self._empty_deadlines = 0
+            w = sender - 1
+            if not self._alive.get(w, False):
+                # an upload from a presumed-dead worker: it's back — count
+                # it in from next round, but drop this (stale) payload
+                LOG.info("worker %d rejoined via upload at round %d", w, self.round_idx)
+                self._alive[w] = True
+            tag = msg.get(MSG_ARG_KEY_ROUND)
+            if tag is not None and int(tag) != self.round_idx:
+                return
+            gen = msg.get(MSG_ARG_KEY_GEN)
+            if gen is not None and int(gen) != self._bcast_gen:
+                return   # pre-re-deal upload of the current round
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         if payload is None:
-            # delta upload: reconstruct the worker model against the global
-            # weights this round was trained from (aggregate() has not run
-            # yet, so aggregator.variables still holds them). Under a lossy
-            # codec the client trained from the DECODED downlink, so
-            # reconstruct against that same lossy image — otherwise every
-            # worker model would be off by the downlink compression error,
-            # a bias the client's error-feedback residual never sees.
-            from fedml_tpu.core.compression import decode_tree, encode_tree
+            # delta upload: reconstruct the worker model against the image
+            # of the downlink the workers trained from this round, cached
+            # at broadcast time (_broadcast_model). Under a lossy codec
+            # that image carries the downlink compression error the client
+            # saw — reconstructing against the raw globals instead would
+            # bias every worker model by an error the client's
+            # error-feedback residual never sees.
             from fedml_tpu.core.pytree import tree_add
 
-            base = self.aggregator.get_global_model_params()
-            # mirror the DOWNLINK codec (sync messages override topk to raw,
-            # see _downlink_codec — so under topk the client trained from the
-            # exact global weights)
-            codec = getattr(self.aggregator.config, "wire_codec", "raw")
-            if codec != "raw" and not codec.startswith("topk"):
-                base = decode_tree(encode_tree(base, codec))
             payload = jax.tree.map(
                 np.asarray,
-                tree_add(base, msg.get(MSG_ARG_KEY_MODEL_DELTA)))
+                tree_add(self._downlink_image, msg.get(MSG_ARG_KEY_MODEL_DELTA)))
         self.aggregator.add_local_trained_result(
             sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
         )
-        if not self.aggregator.check_whether_all_receive():
+        if self._deadline is not None:
+            if not self._expected <= set(self.aggregator.model_dict.keys()):
+                return
+        elif not self.aggregator.check_whether_all_receive():
             return
+        self._complete_round()
+
+    def _complete_round(self):
+        self._cancel_timer()
         global_params = self.aggregator.aggregate()
+        if self._deadline is not None:
+            for i in self.aggregator.flag_client_model_uploaded_dict:
+                self.aggregator.flag_client_model_uploaded_dict[i] = False
         if (
             self.round_idx % self.args.frequency_of_the_test == 0
             or self.round_idx == self.round_num - 1
@@ -190,17 +410,27 @@ class FedAvgEdgeServerManager(ServerManager):
             self.aggregator.test_on_server_for_all_clients(self.round_idx)
         self.round_idx += 1
         if self.round_idx >= self.round_num:
-            for rank in range(1, self.size):
-                self.send_message(Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
-            self.finish()
+            self._teardown()
             return
-        assignments = self._assignments(self.round_idx)
+        self._assignment_map = self._assignments(self.round_idx)
+        self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL, global_params,
+                              self._assignment_map)
+
+    def _teardown(self):
+        """FINISH goes to EVERY worker, dead-marked ones included: a
+        slow-but-alive worker that was dropped from the rounds must still
+        tear down instead of blocking on its queue forever (a truly dead
+        peer's send fails within the send timeout and is swallowed in
+        fault-tolerant mode)."""
+        self._cancel_timer()
         for rank in range(1, self.size):
-            m = Message(MSG_TYPE_S2C_SYNC_MODEL, self.rank, rank)
-            m.codec = self._downlink_codec()
-            m.add_params(MSG_ARG_KEY_MODEL_PARAMS, global_params)
-            m.add_params(MSG_ARG_KEY_CLIENT_INDEX, assignments[rank - 1])
-            self.send_message(m)
+            try:
+                self.send_message(Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
+            except Exception as e:
+                if self._deadline is None:
+                    raise
+                LOG.warning("FINISH to worker %d failed (%s)", rank - 1, e)
+        self.finish()
 
 
 class FedAVGTrainer:
@@ -254,6 +484,22 @@ class FedAvgEdgeClientManager(ClientManager):
         # error-feedback residual for delta uploads (per WORKER, like DGC:
         # the stream being compressed is this worker's upload sequence)
         self._residual = None
+        # fault-tolerant mode: announce ourselves on startup so a restarted
+        # worker process can re-enter a running federation
+        self._ft = getattr(trainer.config, "straggler_deadline_sec", None) is not None
+        self._bcast_gen = None
+
+    def run(self):
+        self.register_message_receive_handlers()
+        if self._ft:
+            # best-effort: a JOIN lost to startup ordering is harmless (the
+            # server ignores JOINs from alive workers and its INIT broadcast
+            # waits for our bind) — it must never kill the worker
+            try:
+                self.send_message(Message(MSG_TYPE_C2S_JOIN, self.rank, 0))
+            except Exception as e:
+                LOG.warning("startup JOIN failed (%s); waiting for init", e)
+        self.com_manager.handle_receive_message()
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
@@ -270,14 +516,27 @@ class FedAvgEdgeClientManager(ClientManager):
         self.round_idx += 1
         self._train_and_send(msg)
 
+    def _train_and_send(self, msg: Message):
+        # the server's round tag drives the RNG stream (identical to the
+        # local counter in a healthy run; after a missed round / rejoin the
+        # tag is the correct one)
+        tag = msg.get(MSG_ARG_KEY_ROUND)
+        if tag is not None:
+            self.round_idx = int(tag)
+        self._bcast_gen = msg.get(MSG_ARG_KEY_GEN)
+        self._do_train_and_send(msg)
+
     def handle_message_finish(self, msg: Message):
         self.finish()
 
-    def _train_and_send(self, msg: Message):
+    def _do_train_and_send(self, msg: Message):
         self.trainer.update_dataset(msg.get(MSG_ARG_KEY_CLIENT_INDEX))
         variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         new_vars, n = self.trainer.train(variables, self.round_idx, self.root_key)
         out = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+        out.add_params(MSG_ARG_KEY_ROUND, self.round_idx)
+        if self._bcast_gen is not None:
+            out.add_params(MSG_ARG_KEY_GEN, self._bcast_gen)
         cfg = self.trainer.config
         if getattr(cfg, "wire_delta", False):
             from fedml_tpu.core.compression import decode_tree, encode_tree
@@ -300,6 +559,53 @@ class FedAvgEdgeClientManager(ClientManager):
         self.send_message(out)
 
 
+def _edge_args(config, dataset):
+    """The small mutable arg bag the managers read (reference passes the raw
+    argparse namespace; here it is derived from FedConfig + dataset)."""
+
+    class Args:
+        pass
+
+    args = Args()
+    args.comm_round = config.comm_round
+    args.client_num_in_total = min(config.client_num_in_total, dataset.num_clients)
+    args.client_num_per_round = min(config.client_num_per_round, args.client_num_in_total)
+    args.frequency_of_the_test = config.frequency_of_the_test
+    return args
+
+
+def build_edge_rank(dataset, config, rank: int, world_size: int, comm,
+                    bundle=None, root_key=None, aggregator=None):
+    """Build ONE rank's manager. Model init and the federation RNG derive
+    deterministically from ``config.seed``, so separate OS processes each
+    construct identical initial state — the reference's "every rank loads
+    the full dataset / builds the full model" pattern
+    (main_fedavg.py:323, FedAvgAPI.py:20-28) without any weight broadcast
+    beyond the protocol's own init message.
+
+    ``bundle``/``root_key``/``aggregator`` let the in-process launcher share
+    one instance across rank threads; per-process callers omit them."""
+    from fedml_tpu.core.rng import seed_everything
+
+    if bundle is None:
+        bundle = create_model(
+            config.model, dataset.class_num,
+            input_shape=dataset.train_x.shape[2:] or None,
+        )
+    if root_key is None:
+        root_key = seed_everything(config.seed)
+    args = _edge_args(config, dataset)
+    if rank == 0:
+        if aggregator is None:
+            aggregator = FedAVGAggregator(
+                bundle.init(root_key), world_size - 1, config,
+                dataset=dataset, bundle=bundle,
+            )
+        return FedAvgEdgeServerManager(args, comm, 0, world_size, aggregator)
+    trainer = FedAVGTrainer(dataset, bundle, config)
+    return FedAvgEdgeClientManager(args, comm, rank, world_size, trainer, root_key)
+
+
 def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = True,
                     comm_factory=None):
     """In-process launch: 1 server + worker_num clients over the local
@@ -310,27 +616,56 @@ def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = Tru
 
     bundle = create_model(config.model, dataset.class_num, input_shape=dataset.train_x.shape[2:] or None)
     root_key = seed_everything(config.seed)
-    variables0 = bundle.init(root_key)
     size = worker_num + 1
-
-    class Args:
-        pass
-
-    args = Args()
-    args.comm_round = config.comm_round
-    args.client_num_in_total = min(config.client_num_in_total, dataset.num_clients)
-    args.client_num_per_round = min(config.client_num_per_round, args.client_num_in_total)
-    args.frequency_of_the_test = config.frequency_of_the_test
-
-    aggregator = FedAVGAggregator(variables0, worker_num, config, dataset=dataset, bundle=bundle)
+    aggregator = FedAVGAggregator(
+        bundle.init(root_key), worker_num, config, dataset=dataset, bundle=bundle
+    )
 
     def make(rank, comm):
-        if rank == 0:
-            return FedAvgEdgeServerManager(args, comm, rank, size, aggregator)
-        trainer = FedAVGTrainer(dataset, bundle, config)
-        return FedAvgEdgeClientManager(args, comm, rank, size, trainer, root_key)
+        return build_edge_rank(dataset, config, rank, size, comm,
+                               bundle=bundle, root_key=root_key,
+                               aggregator=aggregator)
 
     run_ranks(make, size, wire_roundtrip=wire_roundtrip,
               comm_factory=comm_factory,
               codec=getattr(config, "wire_codec", "raw"))
     return aggregator
+
+
+def run_fedavg_edge_rank(dataset, config):
+    """Run THIS process as one rank of a multi-process gRPC federation.
+
+    The deployable counterpart of the reference's per-process launch
+    (``mpirun -np N python main_fedavg.py`` →
+    run_fedavg_distributed_pytorch.sh:21-23, rank branch FedAvgAPI.py:20-28),
+    with rank→IP resolved from ``config.grpc_ipconfig_path`` exactly like
+    the reference's grpc_ipconfig.csv (grpc_comm_manager.py:59-60). Blocks
+    until the federation finishes; returns the aggregator on rank 0 (final
+    global model + test history), None on workers."""
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    if config.rank is None or config.world_size is None:
+        raise ValueError("per-rank deployment needs config.rank and config.world_size")
+    if config.backend.lower() not in ("grpc", "mesh"):
+        raise ValueError(
+            f"per-rank deployment runs over gRPC; got backend={config.backend!r}"
+        )
+    deadline = getattr(config, "straggler_deadline_sec", None)
+    comm = GRPCCommManager(
+        config.rank, config.world_size,
+        ip_config_path=config.grpc_ipconfig_path,
+        base_port=config.grpc_base_port,
+        codec=getattr(config, "wire_codec", "raw"),
+        # Server in fault-tolerant mode: a send that can't reach its peer
+        # within the straggler deadline is as good as failed — fail it so
+        # the round marks the worker dead instead of stalling. Workers keep
+        # the generous default: their sends target the server, and start
+        # order must not matter (docs/deploy.md).
+        send_timeout=deadline if deadline is not None and config.rank == 0
+        else 120.0,
+    )
+    manager = build_edge_rank(dataset, config, config.rank, config.world_size, comm)
+    LOG.info("rank %d/%d entering run loop (grpc base port %d)",
+             config.rank, config.world_size, config.grpc_base_port)
+    manager.run()
+    return manager.aggregator if config.rank == 0 else None

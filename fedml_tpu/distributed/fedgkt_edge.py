@@ -210,6 +210,17 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
     wire run shares init and jitted compute with the simulation."""
     from fedml_tpu.algorithms.fedgkt import FedGKTAPI
 
+    codec = getattr(config, "wire_codec", "raw")
+    if codec.startswith("topk"):
+        # topk is a DELTA compressor (error feedback absorbs the unsent
+        # mass, fedavg_edge only). GKT payloads are full per-sample
+        # features/logits with no residual stream — sparsifying them is
+        # silent corruption, so refuse rather than degrade.
+        raise ValueError(
+            "wire_codec='topk:..' is only valid for delta uploads "
+            "(fedavg_edge with wire_delta); fedgkt_edge exchanges full "
+            "feature/logit payloads — use 'q8' or 'raw'"
+        )
     api = FedGKTAPI(dataset, config, pair=pair, client_blocks=client_blocks,
                     server_blocks_per_stage=server_blocks_per_stage)
     train_one = jax.jit(api._build_client_train_one())

@@ -75,6 +75,14 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
     algorithm = algorithm.lower()
     if algorithm not in ALGORITHMS:
         raise KeyError(f"unknown algorithm {algorithm!r}; known: {ALGORITHMS}")
+    if config.rank is not None and algorithm != "fedavg_edge":
+        # silently running the full single-process simulation on N machines
+        # would be N-fold redundant work and no federation at all
+        raise ValueError(
+            "--rank/--world_size start one process of a multi-process "
+            "deployment, which only the fedavg_edge algorithm supports "
+            f"(got --algorithm {algorithm})"
+        )
 
     if algorithm == "vfl":
         from fedml_tpu.algorithms.vfl import VFLAPI
@@ -105,6 +113,22 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
         # --backend grpc — with optional payload compression (--wire_codec)
         # and error-feedback delta uploads (--wire_delta)
         from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+
+        if config.rank is not None:
+            # TRUE multi-process deployment: this process is ONE rank of a
+            # gRPC federation (reference: mpirun starts N processes, each
+            # branching on its rank — FedAvgAPI.py:20-28). Start it with
+            # experiments.launch_edge or by hand on each machine.
+            from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge_rank
+
+            agg = run_fedavg_edge_rank(ds, config)
+            if agg is None:       # worker rank: nothing to report
+                return {"rank": config.rank, "role": "worker"}
+            hist = agg.test_history
+            return {"rank": 0, "role": "server",
+                    "round": [h["round"] for h in hist],
+                    "Test/Acc": [h["acc"] for h in hist],
+                    "Test/Loss": [h["loss"] for h in hist]}
 
         workers = min(config.client_num_per_round, ds.num_clients)
         if config.backend.lower() == "grpc":

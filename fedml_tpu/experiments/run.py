@@ -20,15 +20,22 @@ def main(argv: Optional[Sequence[str]] = None, default_algorithm: str = "fedavg"
     parser = add_args()
     parser.add_argument("--algorithm", type=str, default=default_algorithm,
                         choices=sorted(ALGORITHMS))
+    parser.add_argument("--result_json", type=str, default=None,
+                        help="write the FULL result dict (history lists "
+                             "included) to this path")
     ns = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(filename)s[line:%(lineno)d] %(levelname)s %(message)s",
     )
     algorithm = ns.algorithm
-    del ns.algorithm
+    result_json = ns.result_json
+    del ns.algorithm, ns.result_json
     cfg = config_from_args(ns)
     result = run_experiment(cfg, algorithm)
+    if result_json:
+        with open(result_json, "w") as f:
+            json.dump({"algorithm": algorithm, **dict(result)}, f)
     printable = {}
     for k, v in dict(result).items():
         if isinstance(v, list) and v and isinstance(v[-1], (int, float)):
