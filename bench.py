@@ -109,7 +109,10 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
     from fedml_tpu.models import create_model
     from fedml_tpu.parallel.mesh import client_mesh
 
-    clients = 4 if tiny else NUM_CLIENTS
+    # BENCH_CS_CLIENTS: silo-count override for the weak-scaling fit
+    # (docs/perf.md): per-client records stay constant, so round compute
+    # scales with the count and T(c) = a + b*c can be fitted from whole runs.
+    clients = 4 if tiny else int(os.environ.get("BENCH_CS_CLIENTS", NUM_CLIENTS))
     records = 8 if tiny else RECORDS_PER_CLIENT
     ds = make_synthetic_classification(
         "cifar10-bench-cs", (32, 32, 3), 10, clients,
@@ -126,12 +129,16 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
         # the grouped mesh schedule (strip-dealt clients, per-group scan
         # lengths) is the measured configuration, like the sim paradigm's
         bucket_groups=int(os.environ.get("BENCH_BUCKET_GROUPS", "6")),
+        # packed mesh schedule: 2 lanes/device measured best at 32 silos
+        # (docs/mfu_experiments.md H5); 0 restores the grouped schedule
+        pack_lanes=int(os.environ.get("BENCH_PACK_LANES_CS", "2")),
         # force residency even on the CPU smoke path so tiny mode exercises
         # the same resident-sharded branch the TPU run measures
         device_data="on",
     )
     bundle = create_model(model, 10, dtype=jnp.bfloat16,
-                          input_shape=ds.train_x.shape[2:])
+                          input_shape=ds.train_x.shape[2:],
+                          bn_impl=os.environ.get("BENCH_BN", "xla"))
     api = CrossSiloFedAvgAPI(ds, cfg, bundle, mesh=client_mesh(1))
     for r in range(1, rounds + 1):
         last = api.run_round(r)
@@ -151,6 +158,7 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
                     "resident-sharded, grouped scan schedule",
         "clients": clients,
         "grouped_schedule": api._group_plan is not None,
+        "packed_schedule": api._packed_mesh is not None,
         "images_per_sec": round(real / dt, 1),
         "padded_images_per_sec": round(padded / dt, 1),
         "rounds_per_sec": round(rounds / dt, 4),
@@ -195,6 +203,10 @@ def main():
         batch_size=batch, epochs=EPOCHS, lr=0.1, momentum=0.9,
         dtype="bfloat16", frequency_of_the_test=10_000, seed=0,
         bucket_groups=int(os.environ.get("BENCH_BUCKET_GROUPS", "6")),
+        # packed schedule (parallel/packed.py): 2 lanes measured best for
+        # the cohort-8 sim round — round-4 campaign, docs/mfu_experiments.md
+        # H5 (0 restores the grouped/bucketed schedule)
+        pack_lanes=int(os.environ.get("BENCH_PACK_LANES", "2")),
         scan_unroll=int(os.environ.get("BENCH_UNROLL", "1")),
         cohort_vmap_width=int(os.environ.get("BENCH_COHORT_WIDTH", "0")),
         # rounds return device-scalar losses (no per-round host sync): the
@@ -204,7 +216,8 @@ def main():
         async_rounds=True,
     )
     bundle = create_model(model, 10, dtype=jnp.bfloat16,
-                          input_shape=ds.train_x.shape[2:])
+                          input_shape=ds.train_x.shape[2:],
+                          bn_impl=os.environ.get("BENCH_BN", "xla"))
     api = FedAvgAPI(ds, cfg, bundle)
 
     # Warmup pass: run every measured round once so each distinct cohort

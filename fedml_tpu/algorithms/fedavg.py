@@ -86,6 +86,7 @@ class FedAvgAPI:
         self._dev_train = self._maybe_place_train_data()
         self._gather_steps: dict[int, Callable] = {}
         self._group_steps: dict[tuple, Callable] = {}
+        self._packed_steps: dict[tuple, Callable] = {}
         if self._dev_train is not None:
             self._round_step_gather = self.build_round_step_gather()
         self.history: dict[str, list] = {"round": [], "Test/Acc": [], "Test/Loss": []}
@@ -364,6 +365,107 @@ class FedAvgAPI:
 
         return round_step
 
+    def _lru_step(self, cache: dict, key, builder, name: str, cap: int = 64):
+        """Shared LRU for compiled round programs (group/packed schedules):
+        bound the cache — with failure injection the per-round plan varies
+        and the key space is large — and make every eviction VISIBLE
+        (history counter + log), since each one implies a fresh XLA compile
+        (minutes through a remote-compile tunnel) next time the key recurs;
+        a pathological config shows up here instead of as mystery slowness.
+        Dict order is recency: hits re-insert, eviction pops the oldest."""
+        step = cache.get(key)
+        if step is None:
+            if len(cache) >= cap:
+                cache.pop(next(iter(cache)))
+                n_evict = self.history.get(f"{name}_evictions", 0) + 1
+                self.history[f"{name}_evictions"] = n_evict
+                log.info("%s cache full: evicted 1 of %d compiled round "
+                         "programs (total evictions %d)", name, cap, n_evict)
+            step = cache[key] = builder()
+        else:
+            cache[key] = cache.pop(key)
+        return step
+
+    # -- packed schedule (parallel/packed.py) --------------------------------
+
+    def _packing_supported(self) -> bool:
+        """Packing folds the weighted mean INTO the lane scan, so it only
+        serves algorithms whose aggregation is the plain weighted mean with
+        stateless servers (FedAvg, FedProx — prox is client-side, injected
+        via _local_train_kwargs). A subclass that rewires build_local_train
+        itself can't be mirrored by the packed lane builder and falls back."""
+        ok = (type(self).aggregate is FedAvgAPI.aggregate
+              and type(self).init_server_state is FedAvgAPI.init_server_state
+              and type(self).build_local_train is FedAvgAPI.build_local_train)
+        if not ok and not getattr(self, "_warned_no_pack", False):
+            log.warning(
+                "pack_lanes=%d ignored: %s customizes aggregation/server "
+                "state, which the packed schedule folds into its lanes",
+                self.config.pack_lanes, type(self).__name__)
+            self._warned_no_pack = True
+        return ok
+
+    def _packed_plan(self, sampled: np.ndarray):
+        from fedml_tpu.parallel.packed import plan_packing
+
+        key = tuple(int(s) for s in sampled)
+        memo = getattr(self, "_packed_plan_memo", None)
+        if memo is not None and memo[0] == key:
+            return memo[1]   # run_round + round_counts share one build
+        c = self.config
+        counts = np.asarray(self.dataset.train_counts, np.float64)[sampled]
+        # finer quantum than the bucketed schedule: a lane amortizes its
+        # rounding tail over several clients, and the tail is pure waste —
+        # the quantum only bounds how many distinct XLA programs the
+        # varying per-round plans can demand (LRU-capped anyway)
+        plan = plan_packing(counts, c.batch_size, c.epochs, c.pack_lanes,
+                            t_quantum=max(1, c.bucket_quantum_batches // 4))
+        self._packed_plan_memo = (key, plan)
+        return plan
+
+    def build_round_step_packed(self, shape_key: tuple):
+        from fedml_tpu.parallel.packed import make_packed_cohort_train
+
+        n_pad = int(self.dataset.train_x.shape[1])
+        packed = make_packed_cohort_train(
+            self.bundle, self.task, n_pad, shape_key,
+            **self._local_train_kwargs())
+
+        @jax.jit
+        def round_step(variables, tx, ty, tm, rows, weights, rng, plan_arrays):
+            acc, acc_w, acc_loss, _tau = packed(
+                variables, tx, ty, tm, rows, weights, rng, plan_arrays)
+            denom = jnp.maximum(acc_w, 1e-12)
+            keep = acc_w > 0    # elastic guard, as in _finish_round
+            new_vars = jax.tree.map(
+                lambda a, v: jnp.where(keep, (a / denom).astype(v.dtype), v),
+                acc, variables)
+            return new_vars, acc_loss / denom
+
+        return round_step
+
+    def _run_packed_round(self, sampled, live, rk):
+        """Execute the round under the packed schedule; returns (variables,
+        loss) or None when packing doesn't apply this round."""
+        if not self._packing_supported():
+            return None
+        plan = self._packed_plan(sampled)
+        if plan is None:
+            return None
+        key = plan.shape_key
+        step = self._lru_step(self._packed_steps, key,
+                              lambda: self.build_round_step_packed(key),
+                              "packed_step")
+        counts = np.asarray(self.dataset.train_counts, np.float32)[sampled]
+        weights = counts if live is None else counts * np.asarray(live, np.float32)
+        plan_arrays = (plan.slot, plan.epoch, plan.sie, plan.reset, plan.emit,
+                       plan.live, plan.member_pos, plan.member_valid,
+                       plan.steps_real)
+        tx, ty, tm, _tc = self._dev_train
+        return step(self.variables, tx, ty, tm,
+                    jnp.asarray(sampled, jnp.int32), jnp.asarray(weights),
+                    rk, tuple(jnp.asarray(a) for a in plan_arrays))
+
     def _sample_failures(self, round_idx: int, cohort: int,
                          record: bool = True) -> Optional[np.ndarray]:
         """Deterministic per-round fault injection (SURVEY.md §5.3: the
@@ -425,6 +527,15 @@ class FedAvgAPI:
         if live is not None:
             counts = counts * live
         n_pad = int(self.dataset.train_x.shape[1])
+        if (self.config.pack_lanes > 0 and self._dev_train is not None
+                and self._packing_supported()):
+            pk = self._packed_plan(sampled)
+            if pk is not None:
+                # packed lanes execute T batch-steps each, every epoch —
+                # report ONE epoch's slots (real counts are per-epoch too)
+                padded = pk.executed_slots * self.config.batch_size \
+                    // max(self.config.epochs, 1)
+                return int(counts.sum()), int(padded)
         plan = self._round_groups(sampled, live) if self._dev_train is not None else None
         if plan is not None:
             padded = sum(s * b for s, b in plan[1])
@@ -443,32 +554,19 @@ class FedAvgAPI:
         if self._dev_train is not None:
             live_np = (np.ones((len(sampled),), np.float32) if live is None
                        else np.asarray(live, np.float32))
+            if self.config.pack_lanes > 0:
+                out = self._run_packed_round(sampled, live, rk)
+                if out is not None:
+                    self.variables, train_loss = out
+                    return (train_loss if self.config.async_rounds
+                            else float(train_loss))
             plan = self._round_groups(sampled, live)
             if plan is not None:
                 perm, groups = plan
-                step = self._group_steps.get(groups)
-                if step is None:
-                    # bound the compile cache: with failure injection the
-                    # live mask varies the group tuple round to round and
-                    # the key space is large — evict least-recently-USED
-                    # (dict order = recency, maintained below)
-                    if len(self._group_steps) >= 64:
-                        self._group_steps.pop(next(iter(self._group_steps)))
-                        n_evict = self.history.get("group_step_evictions", 0) + 1
-                        self.history["group_step_evictions"] = n_evict
-                        # visible counter: every eviction implies a fresh XLA
-                        # compile next time that group tuple recurs — a
-                        # pathological config (high failure_prob + many
-                        # groups) shows up here instead of as mystery slowness
-                        log.info("group-step cache full: evicted 1 of 64 "
-                                 "compiled round programs (total evictions %d)",
-                                 n_evict)
-                    step = self._group_steps[groups] = \
-                        self.build_round_step_gather_groups(groups)
-                else:
-                    # LRU touch: re-insert so steady-state hot group tuples
-                    # stay resident under eviction pressure
-                    self._group_steps[groups] = self._group_steps.pop(groups)
+                step = self._lru_step(
+                    self._group_steps, groups,
+                    lambda: self.build_round_step_gather_groups(groups),
+                    "group_step")
                 self.variables, self.server_state, train_loss = step(
                     self.variables, self.server_state, *self._dev_train,
                     jnp.asarray(sampled[perm], jnp.int32),
@@ -531,8 +629,15 @@ class FedAvgAPI:
         return int(state["round_idx"])
 
     def evaluate_global(self) -> dict:
+        variables = self.variables
+        if jax.process_count() > 1:
+            # round outputs are replicated over the multi-process mesh;
+            # eval is process-local, so pull the (fully-replicated) host
+            # view first — mixing global and local arrays in one jit is
+            # not a valid multi-process program
+            variables = jax.tree.map(np.asarray, variables)
         sums = self._eval(
-            self.variables, self.dataset.test_x, self.dataset.test_y, self.dataset.test_mask
+            variables, self.dataset.test_x, self.dataset.test_y, self.dataset.test_mask
         )
         return finalize_metrics(jax.tree.map(np.asarray, sums))
 
@@ -625,14 +730,66 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                 "always vmaps the per-device client block",
                 config.cohort_vmap_width)
         self._dev_sharded = self._dev_groups = self._group_plan = None
-        plan = self._mesh_group_plan(cohort)
-        if plan is not None:
-            self._dev_groups = self._place_grouped(plan)
-            if self._dev_groups is not None:
-                self._group_plan = plan
-                self._grouped_step = self.build_round_step_grouped(len(plan))
-        if self._dev_groups is None:
-            self._dev_sharded = self._maybe_place_sharded(cohort)
+        self._packed_mesh = None
+        if config.pack_lanes > 0:
+            self._packed_mesh = self._mesh_packed_setup(cohort)
+        if self._packed_mesh is None:
+            plan = self._mesh_group_plan(cohort)
+            if plan is not None:
+                self._dev_groups = self._place_grouped(plan)
+                if self._dev_groups is not None:
+                    self._group_plan = plan
+                    self._grouped_step = self.build_round_step_grouped(len(plan))
+            if self._dev_groups is None:
+                self._dev_sharded = self._maybe_place_sharded(cohort)
+
+    def _mesh_packed_setup(self, cohort: int):
+        """Resident placement + program for the packed mesh schedule
+        (parallel/packed.py): per-device lanes, one psum tail. Returns None
+        when packing doesn't apply (falls back to grouped/sharded)."""
+        from fedml_tpu.parallel.packed import (
+            make_crosssilo_packed_round,
+            plan_packing_mesh,
+        )
+
+        c, ds = self.config, self.dataset
+        if not self._packing_supported():
+            return None
+        if cohort != ds.num_clients:
+            log.warning(
+                "pack_lanes=%d ignored on the mesh path: the packed "
+                "schedule is resident-sharded and needs full participation "
+                "(cohort %d != clients %d)", c.pack_lanes, cohort,
+                ds.num_clients)
+            return None
+        D = self.mesh.shape["clients"]
+        lanes_dev = max(1, -(-c.pack_lanes // D))
+        # full participation -> ONE static plan, compiled once: no reason to
+        # quantize the lane length at all
+        out = plan_packing_mesh(
+            np.asarray(ds.train_counts), c.batch_size, c.epochs, D, lanes_dev,
+            t_quantum=1)
+        if out is None:
+            return None
+        perm, plan = out
+        x = self._eligible_device_train_x(shard_factor=D)
+        if x is None:
+            return None
+        from fedml_tpu.parallel.mesh import shard_client_batch
+
+        n_pad = int(ds.train_x.shape[1])
+        data = shard_client_batch(self.mesh, (
+            x[perm], np.asarray(ds.train_y)[perm],
+            np.asarray(ds.train_mask)[perm]))
+        plan_arrays = shard_client_batch(self.mesh, (
+            plan.slot, plan.epoch, plan.sie, plan.reset, plan.emit, plan.live,
+            plan.member_pos, plan.member_valid, plan.steps_real))
+        round_fn = make_crosssilo_packed_round(
+            self.bundle, self.task, n_pad, self.mesh,
+            **self._local_train_kwargs())
+        return dict(perm=perm, plan=plan, data=data, plan_arrays=plan_arrays,
+                    counts_perm=np.asarray(ds.train_counts, np.float32)[perm],
+                    round_fn=round_fn)
 
     def _maybe_place_sharded(self, cohort: int):
         """Full-participation cross-silo (the standard silo deployment:
@@ -726,7 +883,7 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
 
     def build_round_step_grouped(self, n_groups: int):
         from fedml_tpu.parallel.crosssilo import make_crosssilo_round_grouped
-        from fedml_tpu.parallel.mesh import client_sharded, replicated
+        from fedml_tpu.parallel.mesh import client_sharded, global_put, replicated
 
         round_fn = make_crosssilo_round_grouped(
             self._local_train, self.mesh, n_groups,
@@ -738,16 +895,34 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
             # the grouped schedule changes only the padding steps a client
             # burns, never which randomness it consumes
             keys_full = jax.random.split(rng, self.dataset.num_clients)
-            keys = tuple(jax.device_put(keys_full[idx_g], sh)
-                         for idx_g, _ in self._group_plan)
-            variables = jax.device_put(variables, rep)
-            server_state = jax.device_put(server_state, rep)
+            if jax.process_count() == 1:   # device-side gather (hot path)
+                keys = tuple(jax.device_put(keys_full[idx_g], sh)
+                             for idx_g, _ in self._group_plan)
+            else:                          # global_put handles typed keys
+                keys = tuple(global_put(keys_full[idx_g], sh)
+                             for idx_g, _ in self._group_plan)
+            variables = global_put(variables, rep)
+            server_state = global_put(server_state, rep)
             return round_fn(variables, server_state, groups, counts, keys,
-                            jax.device_put(rng, rep))
+                            global_put(rng, rep))
 
         return round_step
 
     def run_round(self, round_idx: int) -> float:
+        if self._packed_mesh is not None:
+            from fedml_tpu.parallel.mesh import shard_client_batch
+
+            pm = self._packed_mesh
+            live = self._sample_failures(round_idx, self.dataset.num_clients)
+            w = pm["counts_perm"]
+            if live is not None:
+                w = w * np.asarray(live, np.float32)[pm["perm"]]
+            rk = round_key(self.root_key, round_idx)
+            (w_dev,) = shard_client_batch(self.mesh, (w,))
+            self.variables, train_loss = pm["round_fn"](
+                self.variables, *pm["data"], w_dev,
+                jnp.asarray(pm["perm"], jnp.int32), rk, pm["plan_arrays"])
+            return train_loss if self.config.async_rounds else float(train_loss)
         if self._dev_groups is not None:
             groups, counts_res = self._dev_groups
             live = self._sample_failures(round_idx, self.dataset.num_clients)
@@ -778,14 +953,19 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         schedule (no per-round bucketing), so report exactly that: every
         client's real records, and per-group size x scan_len (grouped) or
         cohort x n_pad (plain) executed slots."""
-        if self._dev_groups is None and self._dev_sharded is None:
+        if (self._packed_mesh is None and self._dev_groups is None
+                and self._dev_sharded is None):
             return super().round_counts(round_idx)
         counts = np.asarray(self.dataset.train_counts, np.float64)
         live = self._sample_failures(round_idx, self.dataset.num_clients,
                                      record=False)
         if live is not None:
             counts = counts * live
-        if self._group_plan is not None:
+        if self._packed_mesh is not None:
+            plan = self._packed_mesh["plan"]
+            padded = (plan.executed_slots * self.config.batch_size
+                      // max(self.config.epochs, 1))
+        elif self._group_plan is not None:
             padded = sum(len(idx_g) * bucket for idx_g, bucket in self._group_plan)
         else:
             padded = int(self.dataset.train_x.shape[1]) * self.dataset.num_clients
@@ -812,12 +992,14 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                                         **self._crosssilo_hooks_checked())
 
         def round_step(variables, server_state, cx, cy, cm, counts, rng):
+            from fedml_tpu.parallel.mesh import global_put
+
             keys = jax.random.split(rng, cx.shape[0])
             variables, cx, cy, cm, counts, keys = place_round_inputs(
                 self.mesh, variables, cx, cy, cm, counts, keys
             )
-            server_state = jax.device_put(server_state, replicated(self.mesh))
+            server_state = global_put(server_state, replicated(self.mesh))
             return round_fn(variables, server_state, cx, cy, cm, counts, keys,
-                            jax.device_put(rng, replicated(self.mesh)))
+                            global_put(rng, replicated(self.mesh)))
 
         return round_step

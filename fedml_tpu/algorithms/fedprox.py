@@ -15,16 +15,15 @@ Aggregation is unchanged FedAvg.
 from __future__ import annotations
 
 from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI, FedAvgAPI
-from fedml_tpu.parallel.local import make_local_train_fn
 
 
 class FedProxAPI(FedAvgAPI):
-    def build_local_train(self):
-        return make_local_train_fn(
-            self.bundle, self.task,
-            prox_mu=self.config.fedprox_mu,
-            **self._local_train_kwargs(),
-        )
+    def _local_train_kwargs(self) -> dict:
+        # inject via the shared kwargs mapping (not build_local_train) so
+        # EVERY trainer form — vmapped, grouped, and the packed lanes —
+        # carries the proximal term
+        return dict(super()._local_train_kwargs(),
+                    prox_mu=self.config.fedprox_mu)
 
 
 class CrossSiloFedProxAPI(CrossSiloFedAvgAPI, FedProxAPI):

@@ -138,6 +138,16 @@ class FedConfig:
     # like bucketing itself, the truncated shuffle stream changes the
     # trajectory, not the distribution. Device-resident (gather) path only.
     bucket_groups: int = 1
+    # Client-packing schedule (parallel/packed.py): pack the sampled cohort
+    # into this many fixed-length scan lanes, clients back-to-back with
+    # optimizer reset at boundaries — padding shrinks from group-max
+    # granularity to one batch per client plus the lane tail. 0 = off.
+    # Each client's trajectory replays the canonical unbucketed program
+    # exactly; the aggregate matches up to float summation order. Overrides
+    # bucket_groups on the device-resident simulation path; requires the
+    # algorithm's aggregation to be the plain weighted mean (falls back
+    # with a warning otherwise).
+    pack_lanes: int = 0
     # lax.scan unroll factor for the local-SGD minibatch loop: XLA fuses
     # across adjacent steps (amortizing per-step loop/weight-traffic
     # overheads) without changing the math — same updates in the same
@@ -192,6 +202,8 @@ class FedConfig:
             raise ValueError(f"device_data must be auto|on|off, got {self.device_data!r}")
         if self.bucket_groups < 1:
             raise ValueError(f"bucket_groups must be >= 1, got {self.bucket_groups}")
+        if self.pack_lanes < 0:
+            raise ValueError(f"pack_lanes must be >= 0, got {self.pack_lanes}")
         if self.checkpoint_frequency < 1:
             raise ValueError(
                 f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"
@@ -199,6 +211,12 @@ class FedConfig:
         if not 0.0 <= self.failure_prob < 1.0:
             raise ValueError(
                 f"failure_prob must be in [0, 1), got {self.failure_prob}"
+            )
+        if self.straggler_deadline_sec is not None and self.straggler_deadline_sec <= 0:
+            raise ValueError(
+                f"straggler_deadline_sec must be > 0 (got "
+                f"{self.straggler_deadline_sec}); a non-positive deadline "
+                "would mark every worker dead before it can train"
             )
         if self.rank is not None:
             if self.world_size is None or self.world_size < 2:
@@ -301,6 +319,8 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--bucket_quantum_batches", type=int,
                    default=defaults.bucket_quantum_batches)
     p.add_argument("--bucket_groups", type=int, default=defaults.bucket_groups)
+    p.add_argument("--pack_lanes", type=int, default=defaults.pack_lanes,
+                   help="pack the cohort into N scan lanes (0 = off)")
     p.add_argument("--scan_unroll", type=int, default=defaults.scan_unroll)
     p.add_argument("--cohort_vmap_width", type=int,
                    default=defaults.cohort_vmap_width)

@@ -167,6 +167,29 @@ class FedAvgEdgeServerManager(ServerManager):
         self._expected: set[int] = set(range(size - 1))
         self._timer: Optional[threading.Timer] = None
         self._bcast_gen = 0
+        # checkpoint/resume (reference: none at all, SURVEY.md §5.4; here
+        # the long-running WAN federation — the case that most needs it —
+        # persists global model + round + history every checkpoint_frequency
+        # rounds and resumes bit-identically: sampling/RNG are stateless in
+        # (seed, round), so the model+round+history ARE the whole server)
+        cfg = aggregator.config
+        self._ckpt_path = None
+        if getattr(cfg, "checkpoint_dir", None):
+            import os
+
+            os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+            self._ckpt_path = os.path.join(cfg.checkpoint_dir, "edge_server.ckpt")
+        self._ckpt_freq = int(getattr(cfg, "checkpoint_frequency", 10) or 10)
+        resume = getattr(cfg, "resume_from", None)
+        if resume:
+            from fedml_tpu.utils.checkpoint import load_checkpoint
+
+            state = load_checkpoint(resume)
+            aggregator.variables = state["variables"]
+            self.round_idx = int(state["round_idx"])
+            aggregator.test_history.extend(state["extra"].get("test_history", []))
+            LOG.info("resumed edge federation at round %d from %s",
+                     self.round_idx, resume)
         # consecutive deadlines with zero uploads AND zero alive workers;
         # at _MAX_EMPTY_DEADLINES the federation tears down instead of
         # waiting forever for a rejoin that may never come
@@ -176,8 +199,27 @@ class FedAvgEdgeServerManager(ServerManager):
 
     def run(self):
         self.register_message_receive_handlers()
+        if self.round_idx >= self.round_num:   # resumed a finished run
+            self._teardown()
+            return
         self.send_init_msg()
         self.com_manager.handle_receive_message()
+
+    def _maybe_checkpoint(self):
+        if self._ckpt_path is None:
+            return
+        if (self.round_idx % self._ckpt_freq == 0
+                or self.round_idx >= self.round_num):
+            from fedml_tpu.utils.checkpoint import save_checkpoint
+
+            hist = [
+                {k: (float(v) if hasattr(v, "item") else v) for k, v in h.items()}
+                for h in self.aggregator.test_history
+            ]
+            save_checkpoint(self._ckpt_path,
+                            self.aggregator.get_global_model_params(),
+                            round_idx=self.round_idx,
+                            extra={"test_history": hist})
 
     def _assignments(self, round_idx: int) -> dict[int, list[int]]:
         """Sample client_num_per_round logical clients and deal them to the
@@ -312,7 +354,9 @@ class FedAvgEdgeServerManager(ServerManager):
         self._arm_timer()
 
     def send_init_msg(self):
-        self._assignment_map = self._assignments(0)
+        # round_idx is 0 on a fresh start, R on a resume — the init message
+        # carries the round tag, so workers pick up mid-federation cleanly
+        self._assignment_map = self._assignments(self.round_idx)
         self._broadcast_model(MSG_TYPE_S2C_INIT_CONFIG,
                               self.aggregator.get_global_model_params(),
                               self._assignment_map)
@@ -409,6 +453,7 @@ class FedAvgEdgeServerManager(ServerManager):
         ):
             self.aggregator.test_on_server_for_all_clients(self.round_idx)
         self.round_idx += 1
+        self._maybe_checkpoint()
         if self.round_idx >= self.round_num:
             self._teardown()
             return
@@ -484,10 +529,35 @@ class FedAvgEdgeClientManager(ClientManager):
         # error-feedback residual for delta uploads (per WORKER, like DGC:
         # the stream being compressed is this worker's upload sequence)
         self._residual = None
+        self._residual_round = None
         # fault-tolerant mode: announce ourselves on startup so a restarted
         # worker process can re-enter a running federation
         self._ft = getattr(trainer.config, "straggler_deadline_sec", None) is not None
         self._bcast_gen = None
+        # delta mode: the error-feedback residual is WORKER state the
+        # protocol never ships — persist it beside the server checkpoint so
+        # a resumed federation is bit-identical under a lossy codec
+        cfg = trainer.config
+        self._res_path = None
+        if getattr(cfg, "checkpoint_dir", None) and getattr(cfg, "wire_delta", False):
+            import os
+
+            os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+            self._res_path = os.path.join(
+                cfg.checkpoint_dir, f"edge_worker_{rank}.residual")
+            if getattr(cfg, "resume_from", None) and os.path.exists(self._res_path):
+                from fedml_tpu.core.serialization import tree_from_bytes
+
+                with open(self._res_path, "rb") as f:
+                    state = tree_from_bytes(f.read())
+                self._residual = state["residual"]
+                # the round this residual feeds into; if the server resumed
+                # from an older checkpoint the tag won't match and the
+                # residual is discarded at first sync (clean restart beats a
+                # residual from the future)
+                self._residual_round = int(np.asarray(state["round"]).item())
+                LOG.info("rank %d resumed error-feedback residual for round %d",
+                         rank, self._residual_round)
 
     def run(self):
         self.register_message_receive_handlers()
@@ -538,11 +608,25 @@ class FedAvgEdgeClientManager(ClientManager):
         if self._bcast_gen is not None:
             out.add_params(MSG_ARG_KEY_GEN, self._bcast_gen)
         cfg = self.trainer.config
-        if getattr(cfg, "wire_delta", False):
+        if getattr(cfg, "wire_delta", False) and n <= 0:
+            # zero-weight upload (rejoin catch-up / empty assignment): the
+            # server discards its mass, so folding the error-feedback
+            # residual into it would destroy the residual's compensation —
+            # keep the residual for the next REAL round and ship raw
+            out.add_params(MSG_ARG_KEY_MODEL_PARAMS, new_vars)
+        elif getattr(cfg, "wire_delta", False):
             from fedml_tpu.core.compression import decode_tree, encode_tree
             from fedml_tpu.core.pytree import tree_add, tree_sub
 
             d = tree_sub(new_vars, jax.tree.map(np.asarray, variables))
+            if self._residual_round is not None:
+                if self._residual_round != self.round_idx:
+                    LOG.warning(
+                        "rank %d: resumed residual targets round %d but "
+                        "federation is at round %d; discarding it",
+                        self.rank, self._residual_round, self.round_idx)
+                    self._residual = None
+                self._residual_round = None
             if self._residual is not None:
                 d = tree_add(d, self._residual)
             # simulate the transport's (deterministic) codec so the residual
@@ -552,6 +636,17 @@ class FedAvgEdgeClientManager(ClientManager):
             if codec != "raw":
                 received = decode_tree(encode_tree(d, codec))
                 self._residual = tree_sub(d, received)
+                if self._res_path is not None:
+                    import os
+
+                    from fedml_tpu.core.serialization import tree_to_bytes
+
+                    tmp = self._res_path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(tree_to_bytes({
+                            "residual": self._residual,
+                            "round": np.int64(self.round_idx + 1)}))
+                    os.replace(tmp, self._res_path)
             out.add_params(MSG_ARG_KEY_MODEL_DELTA, d)
         else:
             out.add_params(MSG_ARG_KEY_MODEL_PARAMS, new_vars)

@@ -1,0 +1,51 @@
+"""Flax wrapper for the Pallas fused BatchNorm(+ReLU) kernel.
+
+Drop-in for ``nn.BatchNorm`` on the train path: identical leaf names
+("scale"/"bias" params, "mean"/"var" batch_stats with the same momentum
+update) and identical shapes — only the module-path prefix differs
+(``PallasBatchNorm_i`` vs ``BatchNorm_i``), so the A/B is a constructor
+flag (models/resnet.py ``bn_impl``) with equal parameter counts. Eval
+(running-average) mode is a plain elementwise pass — nothing to fuse
+beyond what XLA already does.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fedml_tpu.ops.batchnorm import fused_bn_relu
+
+
+class PallasBatchNorm(nn.Module):
+    use_running_average: bool
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    fuse_relu: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        C = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones_init(), (C,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (C,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((C,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((C,), jnp.float32))
+        if self.use_running_average:
+            y = (x.astype(jnp.float32) - ra_mean.value) \
+                * jax.lax.rsqrt(ra_var.value + self.epsilon) * scale + bias
+            if self.fuse_relu:
+                y = nn.relu(y)
+            return y.astype(self.dtype or x.dtype)
+        y, mean, var = fused_bn_relu(x, scale, bias, self.epsilon,
+                                     self.fuse_relu)
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+            ra_var.value = m * ra_var.value + (1.0 - m) * var
+        return y

@@ -26,20 +26,35 @@ class BasicBlock(nn.Module):
     dtype: Any = jnp.float32
     bn_axis: Any = None  # mapped-axis name for cross-device sync-BN
     use_norm: bool = True  # False: perf-experiment variant without BN
+    bn_impl: str = "xla"   # "pallas": fused stats+normalize(+relu) kernel
+
+    def _norms(self, train: bool):
+        """norm(fuse_relu) -> module; fuse_relu folds the following ReLU
+        into the norm (only the pallas impl actually fuses it)."""
+        if not self.use_norm:
+            return lambda fuse_relu=False: (
+                nn.relu if fuse_relu else (lambda y: y))
+        if self.bn_impl == "pallas" and self.bn_axis is None:
+            from fedml_tpu.models.norm import PallasBatchNorm
+
+            return lambda fuse_relu=False: PallasBatchNorm(
+                use_running_average=not train, momentum=0.9,
+                dtype=self.dtype, fuse_relu=fuse_relu)
+
+        def make(fuse_relu=False):
+            bn = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                              dtype=self.dtype, axis_name=self.bn_axis)
+            return (lambda y: nn.relu(bn(y))) if fuse_relu else bn
+
+        return make
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        if self.use_norm:
-            norm = partial(nn.BatchNorm, use_running_average=not train,
-                           momentum=0.9, dtype=self.dtype,
-                           axis_name=self.bn_axis)
-        else:
-            def norm():
-                return lambda y: y
+        norm = self._norms(train)
         residual = x
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding="SAME")(x)
-        y = nn.relu(norm()(y))
+        y = norm(fuse_relu=True)(y)
         y = conv(self.filters, (3, 3), padding="SAME")(y)
         y = norm()(y)
         if residual.shape != y.shape:
@@ -61,56 +76,69 @@ class CifarResNet(nn.Module):
     bn_axis: Any = None  # sync-BN over this mapped axis (batchnorm_utils.py counterpart)
     widths: tuple = (16, 32, 64)
     use_norm: bool = True
+    bn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
         if self.use_norm:
-            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                             dtype=self.dtype, axis_name=self.bn_axis)(x)
-        x = nn.relu(x)
+            if self.bn_impl == "pallas" and self.bn_axis is None:
+                from fedml_tpu.models.norm import PallasBatchNorm
+
+                x = PallasBatchNorm(use_running_average=not train,
+                                    momentum=0.9, dtype=self.dtype,
+                                    fuse_relu=True)(x)
+            else:
+                x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=self.dtype, axis_name=self.bn_axis)(x)
+                x = nn.relu(x)
+        else:
+            x = nn.relu(x)
         for stage, filters in enumerate(self.widths):
             for block in range(self.blocks_per_stage):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = BasicBlock(filters, strides, dtype=self.dtype,
                                bn_axis=self.bn_axis,
-                               use_norm=self.use_norm)(x, train=train)
+                               use_norm=self.use_norm,
+                               bn_impl=self.bn_impl)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.output_dim, dtype=jnp.float32)(x.astype(jnp.float32))
 
 
-def _make(depth: int, output_dim: int, dtype=jnp.float32, bn_axis=None) -> CifarResNet:
+def _make(depth: int, output_dim: int, dtype=jnp.float32, bn_axis=None,
+          bn_impl="xla") -> CifarResNet:
     assert (depth - 2) % 6 == 0, "CIFAR ResNet depth must be 6n+2"
-    return CifarResNet((depth - 2) // 6, output_dim, dtype=dtype, bn_axis=bn_axis)
+    return CifarResNet((depth - 2) // 6, output_dim, dtype=dtype,
+                       bn_axis=bn_axis, bn_impl=bn_impl)
 
 
 @register_model("resnet56")
-def _resnet56(output_dim: int, dtype=jnp.float32, bn_axis=None, **_):
+def _resnet56(output_dim: int, dtype=jnp.float32, bn_axis=None, bn_impl="xla", **_):
     return ModelBundle(
         name="resnet56",
-        module=_make(56, output_dim, dtype, bn_axis),
+        module=_make(56, output_dim, dtype, bn_axis, bn_impl),
         input_shape=(32, 32, 3),
         has_batch_stats=True,
     )
 
 
 @register_model("resnet110")
-def _resnet110(output_dim: int, dtype=jnp.float32, bn_axis=None, **_):
+def _resnet110(output_dim: int, dtype=jnp.float32, bn_axis=None, bn_impl="xla", **_):
     return ModelBundle(
         name="resnet110",
-        module=_make(110, output_dim, dtype, bn_axis),
+        module=_make(110, output_dim, dtype, bn_axis, bn_impl),
         input_shape=(32, 32, 3),
         has_batch_stats=True,
     )
 
 
 @register_model("resnet20")
-def _resnet20(output_dim: int, dtype=jnp.float32, bn_axis=None, **_):
+def _resnet20(output_dim: int, dtype=jnp.float32, bn_axis=None, bn_impl="xla", **_):
     """Small variant for CI/tests (not in the reference zoo but same family)."""
     return ModelBundle(
         name="resnet20",
-        module=_make(20, output_dim, dtype, bn_axis),
+        module=_make(20, output_dim, dtype, bn_axis, bn_impl),
         input_shape=(32, 32, 3),
         has_batch_stats=True,
     )

@@ -1,0 +1,285 @@
+"""Fused train-mode BatchNorm(+ReLU) as a Pallas TPU kernel.
+
+Motivation (docs/mfu_experiments.md H2): at the flagship's widths the round
+program is VPU/HBM-bound, and removing BatchNorm entirely measures +18%
+throughput. XLA lowers train-mode BN to a stats reduction pass plus a
+normalize pass (plus their backward), each streaming the activation through
+HBM. This kernel performs BOTH passes per invocation with the activation
+resident in VMEM between them — phase 0 of a two-phase sequential grid
+accumulates the batch statistics, phase 1 normalizes (+ReLU) and writes —
+and its backward fuses the three reductions (dbeta, dgamma, the dx
+projection terms) with the dx elementwise pass the same way.
+
+Numerics match flax ``nn.BatchNorm(use_running_average=False)``: biased
+variance over all leading axes, f32 statistics, scale/bias applied in f32,
+output cast back to the input dtype.
+
+The custom_vjp wrapper makes it a drop-in for the train path; models opt in
+via ``bn_impl='pallas'`` (models/resnet.py) so the A/B against the XLA
+lowering is one flag (measured results: docs/mfu_experiments.md H2-pallas).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` — under
+    shard_map (the cross-silo mesh round) pallas outputs must declare how
+    they vary across the mesh; outside shard_map vma is empty and harmless."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _interpret() -> bool:
+    """Pallas TPU kernels run in interpret mode on CPU backends (unit
+    tests / virtual meshes); compiled on real TPUs."""
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, rstd_ref,
+                acc_ref, *, n_rows: float, eps: float, relu: bool,
+                groups: int):
+    """``groups`` row-groups are folded into the lane dim (x blocks are
+    [chunk, groups*C]) so narrow channel counts still fill the VPU's 128
+    lanes; statistics combine the groups per channel."""
+    phase = pl.program_id(0)
+    chunk = pl.program_id(1)
+    n_chunks = pl.num_programs(1)
+    C = mean_ref.shape[-1]
+
+    @pl.when((phase == 0) & (chunk == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(phase == 0)
+    def _accumulate():
+        x = x_ref[...].astype(jnp.float32)
+        acc_ref[0, :] += jnp.sum(x, axis=0)
+        acc_ref[1, :] += jnp.sum(x * x, axis=0)
+
+    @pl.when((phase == 0) & (chunk == n_chunks - 1))
+    def _stats():
+        # combine the row-groups per channel with static slices (Mosaic has
+        # no general vector reshape)
+        s = acc_ref[0, 0:C]
+        ss = acc_ref[1, 0:C]
+        for g in range(1, groups):
+            s = s + acc_ref[0, g * C:(g + 1) * C]
+            ss = ss + acc_ref[1, g * C:(g + 1) * C]
+        mean = s / n_rows
+        var = ss / n_rows - mean * mean
+        rstd = jax.lax.rsqrt(jnp.maximum(var, 0.0) + eps)
+        acc_ref[0, :] = jnp.concatenate([mean] * groups) if groups > 1 else mean
+        acc_ref[1, :] = jnp.concatenate([rstd] * groups) if groups > 1 else rstd
+        mean_ref[0, :] = mean
+        rstd_ref[0, :] = rstd
+
+    @pl.when(phase == 1)
+    def _normalize():
+        x = x_ref[...].astype(jnp.float32)
+        mean = acc_ref[0, :]
+        rstd = acc_ref[1, :]
+        y = (x - mean) * rstd * gamma_ref[0, :].astype(jnp.float32) \
+            + beta_ref[0, :].astype(jnp.float32)
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _bwd_kernel(x_ref, y_ref, dy_ref, gamma_ref, mean_ref, rstd_ref,
+                dx_ref, dgamma_ref, dbeta_ref, acc_ref,
+                *, n_rows: float, relu: bool, groups: int):
+    """Inputs gamma/mean/rstd arrive pre-tiled to [1, groups*C]; the
+    per-channel dgamma/dbeta outputs are [1, C]."""
+    phase = pl.program_id(0)
+    chunk = pl.program_id(1)
+    n_chunks = pl.num_programs(1)
+    C = dgamma_ref.shape[-1]
+
+    @pl.when((phase == 0) & (chunk == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(phase == 0)
+    def _reduce():
+        dy = dy_ref[...].astype(jnp.float32)
+        if relu:
+            dy = dy * (y_ref[...].astype(jnp.float32) > 0.0)
+        xhat = (x_ref[...].astype(jnp.float32) - mean_ref[0, :]) * rstd_ref[0, :]
+        acc_ref[0, :] += jnp.sum(dy, axis=0)          # dbeta (per group-lane)
+        acc_ref[1, :] += jnp.sum(dy * xhat, axis=0)   # dgamma (per group-lane)
+
+    @pl.when((phase == 0) & (chunk == n_chunks - 1))
+    def _finish_reduce():
+        dbeta = acc_ref[0, 0:C]
+        dgamma = acc_ref[1, 0:C]
+        for g in range(1, groups):
+            dbeta = dbeta + acc_ref[0, g * C:(g + 1) * C]
+            dgamma = dgamma + acc_ref[1, g * C:(g + 1) * C]
+        dbeta_ref[0, :] = dbeta
+        dgamma_ref[0, :] = dgamma
+        acc_ref[0, :] = jnp.concatenate([dbeta] * groups) if groups > 1 else dbeta
+        acc_ref[1, :] = jnp.concatenate([dgamma] * groups) if groups > 1 else dgamma
+
+    @pl.when(phase == 1)
+    def _dx():
+        dy = dy_ref[...].astype(jnp.float32)
+        if relu:
+            dy = dy * (y_ref[...].astype(jnp.float32) > 0.0)
+        xhat = (x_ref[...].astype(jnp.float32) - mean_ref[0, :]) * rstd_ref[0, :]
+        g = gamma_ref[0, :].astype(jnp.float32)
+        dbeta = acc_ref[0, :]
+        dgamma = acc_ref[1, :]
+        dx = (g * rstd_ref[0, :]) * (dy - dbeta / n_rows - xhat * dgamma / n_rows)
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _chunk_for(n: int):
+    """Largest supported row chunk dividing n (None -> XLA fallback)."""
+    for c in (2048, 1024, 512, 256, 128):
+        if n % c == 0:
+            return c
+    return None
+
+
+def _xla_bn_relu(xf, gamma, beta, eps, relu):
+    """Plain-XLA body used when the row count doesn't tile; also the
+    numerics reference the kernel is tested against."""
+    x32 = xf.astype(jnp.float32)
+    mean = x32.mean(axis=0)
+    var = ((x32 - mean) ** 2).mean(axis=0)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (x32 - mean) * rstd * gamma.astype(jnp.float32) \
+        + beta.astype(jnp.float32)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(xf.dtype), mean, rstd
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_bn_relu(x, gamma, beta, eps: float = 1e-5, relu: bool = True):
+    """Train-mode BN(+ReLU) over all leading axes of ``x`` (channels last).
+
+    Returns ``(y, mean, var)`` — mean/var are the BIASED batch statistics
+    (what flax BN uses for both normalization and running-stat updates).
+    """
+    y, mean, rstd, _ = _fwd(x, gamma, beta, eps, relu)
+    var = (1.0 / (rstd * rstd)) - eps
+    return y, mean, var
+
+
+def _fwd(x, gamma, beta, eps, relu):
+    orig_shape = x.shape
+    C = orig_shape[-1]
+    n = int(np.prod(orig_shape[:-1]))
+    # fold G row-groups into the lane dim so narrow C still fills the VPU's
+    # 128 lanes ([n, C] -> [n/G, G*C]); stats recombine per channel in-kernel
+    G = max(1, 128 // C)
+    while G > 1 and n % G:
+        G //= 2
+    rows = n // G
+    Ce = G * C
+    xf = x.reshape(rows, Ce)
+    chunk = _chunk_for(rows)
+    if chunk is None:
+        y, mean, rstd = _xla_bn_relu(x.reshape(n, C), gamma, beta, eps, relu)
+        return (y.reshape(orig_shape), mean, rstd,
+                (x.reshape(n, C), gamma, mean, rstd, y, 1))
+    n_chunks = rows // chunk
+
+    kernel = partial(_fwd_kernel, n_rows=float(n), eps=float(eps), relu=relu,
+                     groups=G)
+    y, mean, rstd = pl.pallas_call(
+        kernel,
+        grid=(2, n_chunks),
+        in_specs=[
+            pl.BlockSpec((chunk, Ce), lambda p, i: (i, 0)),
+            pl.BlockSpec((1, Ce), lambda p, i: (0, 0)),
+            pl.BlockSpec((1, Ce), lambda p, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((chunk, Ce), lambda p, i: (i, 0)),
+            pl.BlockSpec((1, C), lambda p, i: (0, 0)),
+            pl.BlockSpec((1, C), lambda p, i: (0, 0)),
+        ],
+        out_shape=[
+            _sds((rows, Ce), x.dtype, xf),
+            _sds((1, C), jnp.float32, xf),
+            _sds((1, C), jnp.float32, xf),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, Ce), jnp.float32)],
+        interpret=_interpret(),
+    )(xf, jnp.tile(gamma, G).reshape(1, Ce), jnp.tile(beta, G).reshape(1, Ce))
+    return (y.reshape(orig_shape), mean.reshape(C), rstd.reshape(C),
+            (xf, gamma, mean.reshape(C), rstd.reshape(C), y, G))
+
+
+def _fused_fwd(x, gamma, beta, eps, relu):
+    y, mean, rstd, res = _fwd(x, gamma, beta, eps, relu)
+    var = (1.0 / (rstd * rstd)) - eps
+    return (y, mean, var), res
+
+
+def _fused_bwd(eps, relu, res, cts):
+    dy_full, _dmean, _dvar = cts   # stats gradients are not propagated
+    xf, gamma, mean, rstd, y, G = res
+    rows, Ce = xf.shape
+    C = gamma.shape[-1]
+    n = rows * G
+    orig_shape = dy_full.shape
+    dyf = dy_full.reshape(rows, Ce)
+    chunk = _chunk_for(rows)
+    if chunk is None:   # fwd used the XLA fallback (G == 1 by construction)
+        dy = dyf.astype(jnp.float32)
+        if relu:
+            dy = dy * (y.astype(jnp.float32) > 0.0)
+        xhat = (xf.astype(jnp.float32) - mean) * rstd
+        dbeta = dy.sum(axis=0)
+        dgamma = (dy * xhat).sum(axis=0)
+        dx = (gamma.astype(jnp.float32) * rstd) * (
+            dy - dbeta / n - xhat * dgamma / n)
+        return (dx.astype(dy_full.dtype).reshape(orig_shape),
+                dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype))
+    n_chunks = rows // chunk
+
+    kernel = partial(_bwd_kernel, n_rows=float(n), relu=relu, groups=G)
+    dx, dgamma, dbeta = pl.pallas_call(
+        kernel,
+        grid=(2, n_chunks),
+        in_specs=[
+            pl.BlockSpec((chunk, Ce), lambda p, i: (i, 0)),
+            pl.BlockSpec((chunk, Ce), lambda p, i: (i, 0)),
+            pl.BlockSpec((chunk, Ce), lambda p, i: (i, 0)),
+            pl.BlockSpec((1, Ce), lambda p, i: (0, 0)),
+            pl.BlockSpec((1, Ce), lambda p, i: (0, 0)),
+            pl.BlockSpec((1, Ce), lambda p, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((chunk, Ce), lambda p, i: (i, 0)),
+            pl.BlockSpec((1, C), lambda p, i: (0, 0)),
+            pl.BlockSpec((1, C), lambda p, i: (0, 0)),
+        ],
+        out_shape=[
+            _sds((rows, Ce), dy_full.dtype, dyf),
+            _sds((1, C), jnp.float32, dyf),
+            _sds((1, C), jnp.float32, dyf),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, Ce), jnp.float32)],
+        interpret=_interpret(),
+    )(xf, y.reshape(rows, Ce), dyf, jnp.tile(gamma, G).reshape(1, Ce),
+      jnp.tile(mean, G).reshape(1, Ce), jnp.tile(rstd, G).reshape(1, Ce))
+    return (dx.reshape(orig_shape),
+            dgamma.reshape(gamma.shape).astype(gamma.dtype),
+            dbeta.reshape(gamma.shape).astype(gamma.dtype))
+
+
+fused_bn_relu.defvjp(_fused_fwd, _fused_bwd)

@@ -283,7 +283,7 @@ def place_round_inputs(mesh: Mesh, variables, cx, cy, cm, counts, keys, axis="cl
     """Device placement for one round: variables replicated, client-stacked
     arrays sharded along the client axis (the round's single host->device
     transfer)."""
-    from fedml_tpu.parallel.mesh import replicated, shard_client_batch
+    from fedml_tpu.parallel.mesh import global_put, replicated, shard_client_batch
 
-    variables = jax.device_put(variables, replicated(mesh))
+    variables = global_put(variables, replicated(mesh))
     return (variables,) + shard_client_batch(mesh, (cx, cy, cm, counts, keys), axis)

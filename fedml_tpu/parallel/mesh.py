@@ -66,8 +66,38 @@ def client_sharded(mesh: Mesh, axis: str = "clients") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def global_put(x, sh: NamedSharding):
+    """``device_put`` that also works when the mesh spans multiple
+    processes (a pod run bootstrapped by :func:`init_multihost`).
+
+    ``jax.device_put`` refuses shardings with non-addressable devices; in a
+    multi-process run every process instead holds the full host value — the
+    reference's everyone-loads-everything pattern (main_fedavg.py:323) —
+    and contributes its addressable shards via
+    ``make_array_from_process_local_data``. Leaves already carrying the
+    target sharding pass through untouched (round outputs fed back in)."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sh)
+
+    def put_leaf(leaf):
+        if isinstance(leaf, jax.Array) and leaf.sharding == sh:
+            return leaf
+        if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+                leaf.dtype, jax.dtypes.prng_key):
+            # typed PRNG keys have no numpy form: place the raw key data
+            # (trailing key-word dims are replicated by the same spec) and
+            # re-wrap on the global mesh
+            data = np.asarray(jax.random.key_data(leaf))
+            placed = jax.make_array_from_process_local_data(sh, data, data.shape)
+            return jax.random.wrap_key_data(placed, impl=jax.random.key_impl(leaf))
+        arr = np.asarray(leaf)
+        return jax.make_array_from_process_local_data(sh, arr, arr.shape)
+
+    return jax.tree.map(put_leaf, x)
+
+
 def shard_client_batch(mesh: Mesh, arrays: Sequence, axis: str = "clients"):
     """Place stacked per-client arrays with the client axis sharded over the
     mesh and everything else replicated."""
     sh = client_sharded(mesh, axis)
-    return tuple(jax.device_put(a, sh) for a in arrays)
+    return tuple(global_put(a, sh) for a in arrays)

@@ -1,0 +1,453 @@
+"""Client-packing schedule: many small clients share one scan lane.
+
+The bucketed/grouped schedules (algorithms/fedavg.py `_round_groups`,
+`_mesh_group_plan`) cut padding by giving count-sorted client groups their
+own scan lengths — but every client in a group still pads to the group max,
+which left 15% (sim) / 21% (mesh) of executed slots dead in round 3's
+bench. This module removes the group-max: the cohort is packed into a few
+fixed-length lanes (LPT balancing), each lane running its clients
+BACK-TO-BACK in one `lax.scan` with optimizer-state reset at client
+boundaries. Padding shrinks to the final partial batch of each client plus
+the lane tail — one-batch granularity instead of group-max granularity.
+
+Exactness: each client's trajectory REPLAYS the canonical unbucketed
+program (`make_local_train_fn` at full n_pad) bit-for-bit — the same
+per-epoch `jax.random.permutation(ekey, n_pad)` + real-first stable sort
+and the same per-step batch keys, of which the packed lane simply executes
+only the `ceil(count/bs)` real steps. The round aggregate is the same
+weighted mean up to float summation order (lanes accumulate
+`sum(w_i * vars_i)` locally).
+
+The reference has no analogue: its clients are OS processes; padding is a
+TPU-ism (SURVEY.md §7 hard part (a)) and packing is the TPU-native answer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.tasks import Task
+from fedml_tpu.models import ModelBundle
+from fedml_tpu.parallel.local import make_batch_sgd_step, make_optimizer
+
+_EPOCH_KEY_SALT = 0x5ba7   # must match make_local_train_fn's bkeys salt
+
+
+class PackPlan(NamedTuple):
+    """Static lane schedule for one cohort. Shapes (n_lanes, k_max, T) are
+    the compile signature; the arrays are runtime data, so rounds with the
+    same shapes share one XLA program."""
+
+    n_lanes: int
+    k_max: int
+    T: int                 # scan steps per lane
+    epochs: int
+    # [n_lanes, T] per-step metadata
+    slot: np.ndarray       # which member slot trains this step (0 on dead steps)
+    epoch: np.ndarray      # epoch index
+    sie: np.ndarray        # step within the epoch
+    reset: np.ndarray      # 1.0 at a client's first step
+    emit: np.ndarray       # 1.0 at a client's last step
+    live: np.ndarray       # 0.0 on dead lane-tail steps
+    # [n_lanes, k_max] per-member metadata
+    member_pos: np.ndarray   # position in the sampled cohort (0-padded)
+    member_valid: np.ndarray  # 1.0 for real members
+    steps_real: np.ndarray   # ceil(count/bs) per member (>=1 for real members)
+
+    @property
+    def shape_key(self) -> tuple:
+        return (self.n_lanes, self.k_max, self.T, self.epochs)
+
+    @property
+    def executed_slots(self) -> int:
+        """Batch slots the schedule executes (for padded-throughput
+        accounting): lanes x steps x batch — without the batch factor."""
+        return self.n_lanes * self.T
+
+
+def plan_packing(counts: np.ndarray, batch_size: int, epochs: int,
+                 n_lanes: int, t_quantum: int = 1) -> Optional[PackPlan]:
+    """LPT-pack the cohort (client j costs ``epochs * ceil(count_j/bs)``
+    consecutive steps) into ``n_lanes`` lanes; T = max lane load rounded up
+    to ``t_quantum`` steps. Returns None when the cohort is empty."""
+    counts = np.asarray(counts, np.float64)
+    steps = np.ceil(np.maximum(counts, 0.0) / batch_size).astype(np.int64)
+    members = np.nonzero(steps > 0)[0]
+    if members.size == 0 or n_lanes < 1:
+        return None
+    n_lanes = int(min(n_lanes, members.size))
+    cost = epochs * steps[members]
+    order = np.argsort(-cost, kind="stable")          # LPT: biggest first
+    lanes: list[list[int]] = [[] for _ in range(n_lanes)]
+    loads = np.zeros(n_lanes, np.int64)
+    for j in order:
+        l = int(np.argmin(loads))
+        lanes[l].append(int(members[j]))
+        loads[l] += cost[j]
+    T = int(np.ceil(loads.max() / max(t_quantum, 1)) * max(t_quantum, 1))
+    k_max = max(len(l) for l in lanes)
+
+    slot = np.zeros((n_lanes, T), np.int32)
+    epoch = np.zeros((n_lanes, T), np.int32)
+    sie = np.zeros((n_lanes, T), np.int32)
+    reset = np.zeros((n_lanes, T), np.float32)
+    emit = np.zeros((n_lanes, T), np.float32)
+    live = np.zeros((n_lanes, T), np.float32)
+    member_pos = np.zeros((n_lanes, k_max), np.int32)
+    member_valid = np.zeros((n_lanes, k_max), np.float32)
+    steps_real = np.ones((n_lanes, k_max), np.int32)
+
+    for l, mem in enumerate(lanes):
+        t = 0
+        for k, pos in enumerate(mem):
+            member_pos[l, k] = pos
+            member_valid[l, k] = 1.0
+            s = int(steps[pos])
+            steps_real[l, k] = s
+            reset[l, t] = 1.0
+            for e in range(epochs):
+                for si in range(s):
+                    slot[l, t] = k
+                    epoch[l, t] = e
+                    sie[l, t] = si
+                    live[l, t] = 1.0
+                    t += 1
+            emit[l, t - 1] = 1.0
+        # steps t..T-1 stay dead (slot 0, live 0)
+
+    return PackPlan(n_lanes, k_max, T, epochs, slot, epoch, sie, reset, emit,
+                    live, member_pos, member_valid, steps_real)
+
+
+def make_lane_train(
+    bundle: ModelBundle,
+    task: Task,
+    n_pad: int,
+    *,
+    optimizer: str = "sgd",
+    lr: float = 0.01,
+    momentum: float = 0.0,
+    wd: float = 0.0,
+    epochs: int = 1,
+    batch_size: int = 32,
+    grad_clip: Optional[float] = None,
+    prox_mu: float = 0.0,
+    compute_dtype=None,
+    scan_unroll: int = 1,
+) -> Callable:
+    """Build the single-lane program both execution forms share: the
+    simulation paradigm vmaps it over all lanes
+    (:func:`make_packed_cohort_train`), the cross-silo mesh shard_maps it
+    with a psum tail (:func:`make_crosssilo_packed_round`)."""
+    del compute_dtype  # callers pre-cast the stacked arrays once
+    tx_opt = make_optimizer(optimizer, lr, momentum, wd)
+    batch_step = make_batch_sgd_step(
+        bundle, task, tx_opt, grad_clip=grad_clip, prox_mu=prox_mu,
+        compute_dtype=None,
+    )
+    steps_full = n_pad // batch_size
+    bs = batch_size
+
+    def lane_train(variables0, x_flat, y_flat, m_flat, mask_rows,
+                   member_row, member_keys, member_w, steps_real,
+                   slot, epoch_a, sie, reset, emit, live):
+        """One lane. x_flat/y_flat/m_flat: [C*n_pad, ...] flattened stacks
+        (shared, unbatched); mask_rows [C, n_pad]; member_* are this lane's
+        [k_max] arrays; per-step metadata [T]."""
+        params0 = variables0["params"]
+        opt_state0 = tx_opt.init(params0)
+
+        # Exact replay of make_local_train_fn's per-epoch order and batch
+        # keys, per member: perm over the GLOBAL n_pad (uniform shape),
+        # real-first stable sort, bkeys = split(fold_in(ekey, salt), steps).
+        def member_tables(key, row):
+            mask_row = mask_rows[row]
+            ekeys = jax.random.split(key, epochs)
+
+            def per_epoch(ek):
+                perm = jax.random.permutation(ek, n_pad)
+                order = perm[jnp.argsort(-mask_row[perm], stable=True)]
+                bkeys = jax.random.split(
+                    jax.random.fold_in(ek, _EPOCH_KEY_SALT), steps_full)
+                return order, bkeys
+
+            return jax.vmap(per_epoch)(ekeys)   # [E, n_pad], [E, steps_full]
+
+        orders, bkeys = jax.vmap(member_tables)(member_keys, member_row)
+
+        def step_fn(carry, xs):
+            variables, opt_state, loss_acc, acc_vars, acc_w, acc_loss, acc_tau = carry
+            k, e, s, rs, em, lv = xs
+            variables = jax.tree.map(
+                lambda v, z: jnp.where(rs > 0, z, v), variables, variables0)
+            opt_state = jax.tree.map(
+                lambda v, z: jnp.where(rs > 0, z, v), opt_state, opt_state0)
+            loss_acc = jnp.where(rs > 0, 0.0, loss_acc)
+
+            row = member_row[k]
+            oseg = jax.lax.dynamic_slice(
+                orders, (k, e, s * bs), (1, 1, bs)).reshape(bs)
+            flat = row * n_pad + oseg
+            bx = jnp.take(x_flat, flat, axis=0)
+            by = jnp.take(y_flat, flat, axis=0)
+            bm = jnp.take(m_flat, flat, axis=0)
+            bkey = bkeys[k, e, s]
+
+            new_vars, new_opt, l = batch_step(
+                variables, opt_state, params0, bx, by, bm, bkey)
+
+            def freeze_if_dead(new, old):
+                return jax.tree.map(
+                    lambda n, o: lv * n + (1.0 - lv) * o
+                    if jnp.issubdtype(n.dtype, jnp.floating)
+                    else jnp.where(lv > 0, n, o),
+                    new, old,
+                )
+
+            new_opt = freeze_if_dead(new_opt, opt_state)
+            out_vars = dict(freeze_if_dead(new_vars, variables))
+
+            lastep = (e == epochs - 1).astype(jnp.float32)
+            loss_acc = loss_acc + l * lv * lastep
+
+            w = member_w[k] * em
+            sr = jnp.maximum(steps_real[k].astype(jnp.float32), 1.0)
+            acc_vars = jax.tree.map(lambda a, v: a + w * v, acc_vars, out_vars)
+            acc_w = acc_w + w
+            acc_loss = acc_loss + w * loss_acc / sr
+            acc_tau = acc_tau + w * epochs * sr
+            return (out_vars, new_opt, loss_acc, acc_vars, acc_w, acc_loss,
+                    acc_tau), None
+
+        # zeros DERIVED from inputs, not constants: under shard_map the
+        # inputs are device-varying, and a constant-zero carry init would
+        # type-clash with the varying carry the scan body produces
+        z = jnp.sum(member_w) * 0.0
+        acc0 = jax.tree.map(lambda v: v.astype(jnp.float32) * 0.0, variables0)
+        carry0 = (variables0, opt_state0, z, acc0, z, z, z)
+        (_, _, _, acc_vars, acc_w, acc_loss, acc_tau), _ = jax.lax.scan(
+            step_fn, carry0, (slot, epoch_a, sie, reset, emit, live),
+            unroll=max(int(scan_unroll), 1),
+        )
+        return acc_vars, acc_w, acc_loss, acc_tau
+
+    return lane_train
+
+
+def make_packed_cohort_train(
+    bundle: ModelBundle,
+    task: Task,
+    n_pad: int,
+    shape_key: tuple,
+    *,
+    compute_dtype=None,
+    **lane_kwargs,
+) -> Callable:
+    """Build the packed-cohort program (simulation paradigm) for one plan
+    SHAPE: vmap of the lane program over all lanes.
+
+    Returns ``packed_train(variables, tx, ty, tm, sampled_rows, weights_pos,
+    rng, plan_arrays) -> (acc_vars, acc_w, acc_loss, acc_tau)`` summed over
+    all lanes. Aggregate = ``acc_vars / acc_w`` (elastic-guarded by the
+    caller)."""
+    del shape_key  # lanes are vmapped; shapes come in via the arrays
+    lane_train = make_lane_train(bundle, task, n_pad, **lane_kwargs)
+
+    def packed_train(variables, tx, ty, tm, sampled_rows, weights_pos, rng,
+                     plan_arrays):
+        """``tx/ty/tm``: the full stacked client arrays [C_total, n_pad, ...]
+        (device-resident); ``sampled_rows`` [cohort] maps cohort position ->
+        stack row; ``weights_pos`` [cohort] aggregation weights (count x
+        live) by position; ``rng`` the round key (per-position keys derive
+        exactly as in the unpacked paths: split(rng, cohort)[position])."""
+        (slot, epoch_a, sie, reset, emit, live,
+         member_pos, member_valid, steps_real) = plan_arrays
+        if compute_dtype is not None and jnp.issubdtype(tx.dtype, jnp.floating):
+            tx = tx.astype(compute_dtype)
+        C = tx.shape[0]
+        x_flat = tx.reshape((C * n_pad,) + tx.shape[2:])
+        y_flat = ty.reshape((C * n_pad,) + ty.shape[2:])
+        m_flat = tm.reshape((C * n_pad,))
+        keys_full = jax.random.split(rng, sampled_rows.shape[0])
+        member_row = sampled_rows[member_pos]      # [n_lanes, k_max]
+        member_keys = keys_full[member_pos]
+        member_w = weights_pos[member_pos] * member_valid
+
+        lanes = jax.vmap(
+            lane_train,
+            in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
+        )(variables, x_flat, y_flat, m_flat, tm,
+          member_row, member_keys, member_w, steps_real,
+          slot, epoch_a, sie, reset, emit, live)
+        acc_vars, acc_w, acc_loss, acc_tau = lanes
+        return (jax.tree.map(lambda a: jnp.sum(a, axis=0), acc_vars),
+                jnp.sum(acc_w), jnp.sum(acc_loss), jnp.sum(acc_tau))
+
+    return packed_train
+
+
+# --- cross-silo mesh form ---------------------------------------------------
+
+def pad_plan(plan: PackPlan, T: int, k_max: int, n_lanes: int) -> PackPlan:
+    """Pad a plan to shared (n_lanes, k_max, T) so per-device plans form one
+    SPMD-uniform program (extra steps/members/lanes are dead: live 0,
+    member_valid 0)."""
+
+    def pad2(a, rows, cols, fill=0):
+        out = np.full((rows, cols), fill, a.dtype)
+        out[: a.shape[0], : a.shape[1]] = a
+        return out
+
+    return PackPlan(
+        n_lanes, k_max, T, plan.epochs,
+        pad2(plan.slot, n_lanes, T), pad2(plan.epoch, n_lanes, T),
+        pad2(plan.sie, n_lanes, T), pad2(plan.reset, n_lanes, T),
+        pad2(plan.emit, n_lanes, T), pad2(plan.live, n_lanes, T),
+        pad2(plan.member_pos, n_lanes, k_max),
+        pad2(plan.member_valid, n_lanes, k_max),
+        pad2(plan.steps_real, n_lanes, k_max, fill=1),
+    )
+
+
+def plan_packing_mesh(counts: np.ndarray, batch_size: int, epochs: int,
+                      n_devices: int, lanes_per_device: int,
+                      t_quantum: int = 1):
+    """Mesh packing: deal clients to devices by capacity-constrained LPT
+    (biggest client first to the least-loaded device with a free row — see
+    the inline comment for why this beats the `_mesh_group_plan` strip
+    deal here), pack each device's clients into its own lanes, and pad
+    every per-device plan to shared shapes (SPMD: one program, all
+    devices).
+
+    Returns ``(perm, plan)`` or None: ``perm`` is the device-major client
+    order for data placement (device d's block = perm[d*L:(d+1)*L]); the
+    plan's lane axis is device-major [D*lanes_dev, ...] to be sharded along
+    the mesh axis; ``member_pos`` index LOCAL rows within a device block.
+    """
+    counts = np.asarray(counts, np.float64)
+    C = len(counts)
+    D = int(n_devices)
+    if C % D or C // D < 1:
+        return None
+    L = C // D
+    # capacity-constrained LPT: biggest client first, to the least-loaded
+    # device that still has a free row — the whale client's device gets the
+    # smallest co-residents, so T (= max device load = the round's critical
+    # path) approaches the whale bound instead of stacking big clients
+    # together the way a count-sorted strip deal does
+    cost = epochs * np.ceil(np.maximum(counts, 0.0) / batch_size)
+    order = np.argsort(-cost, kind="stable")
+    loads = np.zeros(D)
+    dev_clients = [[] for _ in range(D)]
+    for j in order:
+        free = [d for d in range(D) if len(dev_clients[d]) < L]
+        d = min(free, key=lambda i: loads[i])
+        dev_clients[d].append(int(j))
+        loads[d] += cost[j]
+    dev_clients = [np.asarray(m, np.int64) for m in dev_clients]
+    plans = []
+    for d in range(D):
+        p = plan_packing(counts[dev_clients[d]], batch_size, epochs,
+                         lanes_per_device, t_quantum=t_quantum)
+        if p is None:
+            return None
+        plans.append(p)
+    T = max(p.T for p in plans)
+    k_max = max(p.k_max for p in plans)
+    n_lanes_dev = max(p.n_lanes for p in plans)
+    plans = [pad_plan(p, T, k_max, n_lanes_dev) for p in plans]
+
+    def cat(field):
+        return np.concatenate([getattr(p, field) for p in plans], axis=0)
+
+    plan = PackPlan(
+        D * n_lanes_dev, k_max, T, epochs,
+        cat("slot"), cat("epoch"), cat("sie"), cat("reset"), cat("emit"),
+        cat("live"), cat("member_pos"), cat("member_valid"), cat("steps_real"),
+    )
+    return np.concatenate(dev_clients), plan
+
+
+def make_crosssilo_packed_round(
+    bundle: ModelBundle,
+    task: Task,
+    n_pad: int,
+    mesh,
+    axis: str = "clients",
+    *,
+    compute_dtype=None,
+    **lane_kwargs,
+) -> Callable:
+    """Mesh form of the packed schedule: each device runs its lanes (vmap of
+    the SAME lane program the simulation paradigm uses), and ONE weighted
+    psum tail aggregates all lanes' accumulators — the packed counterpart of
+    `make_crosssilo_round_grouped`, with the group-max padding replaced by
+    one-batch-granularity lanes.
+
+    Returns ``round_fn(variables, tx, ty, tm, weights, rng, plan_arrays) ->
+    (variables, loss)`` where tx/ty/tm/weights are stacked in PLAN ORDER
+    (device-major perm from `plan_packing_mesh`) and sharded along ``axis``,
+    plan_arrays are the PackPlan arrays (lane axis sharded along ``axis``),
+    and variables/rng are replicated.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    lane_train = make_lane_train(bundle, task, n_pad, **lane_kwargs)
+
+    def shard_fn(variables, tx, ty, tm, weights, keys, plan_arrays, rng):
+        del rng
+        (slot, epoch_a, sie, reset, emit, live,
+         member_pos, member_valid, steps_real) = plan_arrays
+        variables0 = variables
+        variables = jax.tree.map(
+            lambda x: jax.lax.pcast(x, axis_name=axis, to="varying"), variables
+        )
+        L = tx.shape[0]
+        x_flat = tx.reshape((L * n_pad,) + tx.shape[2:])
+        y_flat = ty.reshape((L * n_pad,) + ty.shape[2:])
+        m_flat = tm.reshape((L * n_pad,))
+        member_keys = keys[member_pos]
+        member_w = weights[member_pos] * member_valid
+
+        acc_vars, acc_w, acc_loss, _tau = jax.vmap(
+            lane_train,
+            in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
+        )(variables, x_flat, y_flat, m_flat, tm,
+          member_pos, member_keys, member_w, steps_real,
+          slot, epoch_a, sie, reset, emit, live)
+
+        acc_vars = jax.tree.map(
+            lambda a: jax.lax.psum(jnp.sum(a, axis=0), axis), acc_vars)
+        total = jax.lax.psum(jnp.sum(acc_w), axis)
+        loss_sum = jax.lax.psum(jnp.sum(acc_loss), axis)
+        denom = jnp.maximum(total, 1e-12)
+        keep = total > 0   # elastic all-failed rollback (as _make_mesh_finish)
+        new_vars = jax.tree.map(
+            lambda a, v: jnp.where(keep, (a / denom).astype(v.dtype), v),
+            acc_vars, variables0)
+        return new_vars, loss_sum / denom
+
+    p_plan = tuple(P(axis) for _ in range(9))
+    mapped = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis), p_plan, P()),
+        out_specs=(P(), P()),
+    )
+
+    def round_fn(variables, tx, ty, tm, weights, perm, rng, plan_arrays):
+        """``perm``: the device-major client order from plan_packing_mesh —
+        every client keeps the per-round key of its ORIGINAL index (same
+        rule as the grouped mesh schedule), so the packing changes only the
+        padding, never which randomness a client consumes."""
+        if compute_dtype is not None and jnp.issubdtype(tx.dtype, jnp.floating):
+            tx = tx.astype(compute_dtype)
+        keys = jax.random.split(rng, weights.shape[0])[perm]
+        return mapped(variables, tx, ty, tm, weights, keys, plan_arrays, rng)
+
+    return jax.jit(round_fn)

@@ -1,0 +1,118 @@
+"""Fused Pallas BatchNorm(+ReLU): numerics vs the XLA lowering.
+
+The kernel (ops/batchnorm.py) exists to attack the measured ~18% BN share
+of the flagship step (docs/mfu_experiments.md H2). These tests pin that it
+is a NUMERICAL drop-in: same forward, same gradients, same running-stat
+updates as flax nn.BatchNorm — so the on-chip A/B (BENCH_BN=pallas) is a
+pure performance experiment.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.models import create_model
+from fedml_tpu.ops.batchnorm import fused_bn_relu
+
+
+def _ref_bn_relu(x, gamma, beta, eps=1e-5, relu=True):
+    xf = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    mean = xf.mean(axis=0)
+    var = ((xf - mean) ** 2).mean(axis=0)
+    y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype), mean, var
+
+
+def test_kernel_forward_and_grads_match_reference():
+    rng = np.random.default_rng(0)
+    for shape, relu in (((4, 32, 32, 16), True), ((2, 2048, 8), False),
+                        ((5, 100, 24), True)):   # last: ragged -> XLA fallback
+        C = shape[-1]
+        x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        g = jnp.asarray(rng.normal(size=(C,)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(C,)).astype(np.float32))
+
+        y, m, v = jax.jit(lambda x, g, b: fused_bn_relu(x, g, b, 1e-5, relu))(x, g, b)
+        yr, mr, vr = _ref_bn_relu(x, g, b, relu=relu)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(m), np.asarray(mr), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(v), np.asarray(vr), atol=1e-5)
+
+        def loss_k(x, g, b):
+            return jnp.sum(jnp.sin(fused_bn_relu(x, g, b, 1e-5, relu)[0]))
+
+        def loss_r(x, g, b):
+            return jnp.sum(jnp.sin(_ref_bn_relu(x, g, b, relu=relu)[0]))
+
+        gk = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))(x, g, b)
+        gr = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(x, g, b)
+        for a, r in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-5, atol=1e-3)
+
+
+def _rename(tree, frm, to):
+    if isinstance(tree, dict):
+        return {k.replace(frm, to): _rename(v, frm, to) for k, v in tree.items()}
+    return tree
+
+
+def test_resnet_pallas_bn_matches_xla_bn_end_to_end():
+    """Same resnet20, both BN impls, IDENTICAL weights (module-path rename):
+    training-mode forward, gradients, and batch_stats updates must agree."""
+    xla = create_model("resnet20", 10)
+    pal = create_model("resnet20", 10, bn_impl="pallas")
+    key = jax.random.PRNGKey(0)
+    vars_p = pal.init(key)
+    vars_x = _rename(vars_p, "PallasBatchNorm", "BatchNorm")
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, size=(8,)))
+
+    def loss(bundle, variables, x, y, dk):
+        logits, new_vars = bundle.apply_train(variables, x, dk)
+        l = -jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(8), y])
+        return l, new_vars
+
+    dk = jax.random.PRNGKey(2)
+    (lp, nvp), gp = jax.value_and_grad(
+        lambda v: loss(pal, v, x, y, dk), has_aux=True)(vars_p)
+    (lx, nvx), gx = jax.value_and_grad(
+        lambda v: loss(xla, v, x, y, dk), has_aux=True)(vars_x)
+
+    np.testing.assert_allclose(float(lp), float(lx), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(_rename(gp, "PallasBatchNorm", "BatchNorm")),
+                    jax.tree.leaves(gx)):
+        # deep chain of f32 reductions in different orders (the kernel also
+        # folds row-groups into lanes): elementwise noise up to a few 1e-3
+        # absolute is expected; the loss match above and the kernel-level
+        # gradient test are the tight anchors
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=3e-3)
+    # running-stat updates identical
+    for a, b in zip(
+            jax.tree.leaves(_rename(nvp, "PallasBatchNorm", "BatchNorm")["batch_stats"]),
+            jax.tree.leaves(nvx["batch_stats"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_pallas_bn_trains():
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+
+    ds = make_synthetic_classification(
+        "pbn", (16, 16, 3), 4, 4, records_per_client=32,
+        partition_method="homo", batch_size=16, seed=0)
+    cfg = FedConfig(model="resnet20", dataset="pbn", client_num_in_total=4,
+                    client_num_per_round=4, comm_round=2, batch_size=16,
+                    lr=0.05, frequency_of_the_test=1, seed=0,
+                    device_data="off")
+    bundle = create_model("resnet20", 4, input_shape=(16, 16, 3),
+                          bn_impl="pallas")
+    h = FedAvgAPI(ds, cfg, bundle).train()
+    assert np.isfinite(h["Test/Loss"]).all()

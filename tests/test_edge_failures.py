@@ -210,3 +210,55 @@ def test_straggler_deadline_config_flag():
     cfg = _cfg(straggler_deadline_sec=5.0)
     assert cfg.straggler_deadline_sec == 5.0
     assert _cfg().straggler_deadline_sec is None
+    with pytest.raises(ValueError):
+        _cfg(straggler_deadline_sec=0.0)
+    with pytest.raises(ValueError):
+        _cfg(straggler_deadline_sec=-1.0)
+
+
+def test_rejoin_zero_weight_upload_preserves_ef_residual():
+    """A rejoining wire_delta worker's catch-up reply has zero weight; the
+    server discards its mass, so the error-feedback residual must NOT be
+    folded into it (that would silently destroy the residual)."""
+    import numpy as np_
+
+    from fedml_tpu.distributed.fedavg_edge import (
+        MSG_ARG_KEY_CLIENT_INDEX,
+        MSG_ARG_KEY_MODEL_PARAMS,
+        MSG_TYPE_S2C_SYNC_MODEL,
+        FedAVGTrainer,
+        MSG_ARG_KEY_MODEL_DELTA,
+    )
+
+    ds = _ds()
+    cfg = _cfg(wire_codec="q8", wire_delta=True, straggler_deadline_sec=30.0)
+
+    sent = []
+
+    class Capture(FedAvgEdgeClientManager):
+        def send_message(self, m):
+            sent.append(m)
+
+    from fedml_tpu.models import create_model
+    from fedml_tpu.core.rng import seed_everything
+
+    bundle = create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:])
+    root = seed_everything(cfg.seed)
+    trainer = FedAVGTrainer(ds, bundle, cfg)
+
+    class _Comm:
+        def add_observer(self, o):
+            pass
+
+    mgr = Capture(type("A", (), {"comm_round": 4})(), _Comm(), 1, 3, trainer, root)
+    residual = {"w": np_.ones((3,), np_.float32)}
+    mgr._residual = residual
+
+    m = Message(MSG_TYPE_S2C_SYNC_MODEL, 0, 1)
+    m.add_params(MSG_ARG_KEY_MODEL_PARAMS, bundle.init(root))
+    m.add_params(MSG_ARG_KEY_CLIENT_INDEX, [])   # catch-up: empty assignment
+    mgr.handle_message_receive_model_from_server(m)
+
+    assert mgr._residual is residual             # untouched
+    out = sent[-1]
+    assert out.get(MSG_ARG_KEY_MODEL_DELTA) is None   # shipped raw, not delta
