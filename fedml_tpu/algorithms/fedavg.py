@@ -753,7 +753,24 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         )
 
         c, ds = self.config, self.dataset
-        if not self._packing_supported():
+        if type(self).build_local_train is not FedAvgAPI.build_local_train:
+            if not getattr(self, "_warned_no_pack", False):
+                log.warning(
+                    "pack_lanes=%d ignored: %s rewires build_local_train, "
+                    "which the packed lane builder cannot mirror",
+                    c.pack_lanes, type(self).__name__)
+                self._warned_no_pack = True
+            return None
+        try:
+            # the mesh form supports the full hook contract (FedOpt/FedNova/
+            # AGC/robust server state and transforms ride the lanes)
+            hooks = self._crosssilo_hooks_checked()
+        except NotImplementedError:
+            if not getattr(self, "_warned_no_pack", False):
+                log.warning(
+                    "pack_lanes=%d ignored: %s overrides aggregate() without "
+                    "crosssilo hooks", c.pack_lanes, type(self).__name__)
+                self._warned_no_pack = True
             return None
         if cohort != ds.num_clients:
             log.warning(
@@ -786,7 +803,7 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
             plan.member_pos, plan.member_valid, plan.steps_real))
         round_fn = make_crosssilo_packed_round(
             self.bundle, self.task, n_pad, self.mesh,
-            **self._local_train_kwargs())
+            **hooks, **self._local_train_kwargs())
         return dict(perm=perm, plan=plan, data=data, plan_arrays=plan_arrays,
                     counts_perm=np.asarray(ds.train_counts, np.float32)[perm],
                     round_fn=round_fn)
@@ -919,8 +936,8 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                 w = w * np.asarray(live, np.float32)[pm["perm"]]
             rk = round_key(self.root_key, round_idx)
             (w_dev,) = shard_client_batch(self.mesh, (w,))
-            self.variables, train_loss = pm["round_fn"](
-                self.variables, *pm["data"], w_dev,
+            self.variables, self.server_state, train_loss = pm["round_fn"](
+                self.variables, self.server_state, *pm["data"], w_dev,
                 jnp.asarray(pm["perm"], jnp.int32), rk, pm["plan_arrays"])
             return train_loss if self.config.async_rounds else float(train_loss)
         if self._dev_groups is not None:

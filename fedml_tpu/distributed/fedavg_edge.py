@@ -620,10 +620,15 @@ class FedAvgEdgeClientManager(ClientManager):
 
             d = tree_sub(new_vars, jax.tree.map(np.asarray, variables))
             if self._residual_round is not None:
-                if self._residual_round != self.round_idx:
+                # discard only a FUTURE-tagged residual (server resumed from
+                # an older checkpoint than the residual's round). A PAST tag
+                # is normal: zero-weight uploads (rejoin catch-up / empty
+                # assignment) deliberately hold the residual for the next
+                # real round, so the tag may trail round_idx.
+                if self._residual_round > self.round_idx:
                     LOG.warning(
-                        "rank %d: resumed residual targets round %d but "
-                        "federation is at round %d; discarding it",
+                        "rank %d: resumed residual targets future round %d "
+                        "but federation is at round %d; discarding it",
                         self.rank, self._residual_round, self.round_idx)
                     self._residual = None
                 self._residual_round = None

@@ -129,23 +129,33 @@ def _make_mesh_finish(axis, client_transform, reduce_extras, server_update):
                 reduce_extras(variables, res, w),
             )
         loss = jax.lax.psum(jnp.sum(res.train_loss * w), axis) / denom
-        if server_update is not None:
-            new_vars, new_state = server_update(
-                variables0, agg, extras, total, server_state, server_key(rng)
-            )
-        else:
-            new_vars, new_state = agg, server_state
-        # elastic rounds: zero-count clients (failed/dropped, counts*live=0)
-        # contribute nothing; if EVERY client failed the round is a full
-        # no-op — weights AND server state roll back (matching the
-        # simulation paradigm's _finish_round guard), else the server
-        # optimizer would absorb the garbage zero-aggregate pseudo-gradient
-        keep = total > 0
-        new_vars = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_vars, variables0)
-        new_state = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_state, server_state)
+        new_vars, new_state = apply_server_and_rollback(
+            variables0, agg, extras, total, server_state, rng, server_update)
         return new_vars, new_state, loss
 
     return finish
+
+
+def apply_server_and_rollback(variables0, agg, extras, total, server_state,
+                              rng, server_update):
+    """The ONE post-aggregation tail every mesh round shares (plain,
+    grouped, and packed — parallel/packed.py): the server hook on
+    replicated values with the round's server key, then the elastic
+    all-failed rollback. Zero-count clients (failed/dropped, counts*live=0)
+    contribute nothing to ``agg``; if EVERY client failed the round is a
+    full no-op — weights AND server state roll back (matching the
+    simulation paradigm's _finish_round guard), else the server optimizer
+    would absorb the garbage zero-aggregate pseudo-gradient."""
+    if server_update is not None:
+        new_vars, new_state = server_update(
+            variables0, agg, extras, total, server_state, server_key(rng)
+        )
+    else:
+        new_vars, new_state = agg, server_state
+    keep = total > 0
+    new_vars = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_vars, variables0)
+    new_state = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_state, server_state)
+    return new_vars, new_state
 
 
 def make_crosssilo_round_grouped(

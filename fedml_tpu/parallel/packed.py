@@ -138,12 +138,21 @@ def make_lane_train(
     prox_mu: float = 0.0,
     compute_dtype=None,
     scan_unroll: int = 1,
+    client_transform: Optional[Callable] = None,
+    reduce_extras: Optional[Callable] = None,
 ) -> Callable:
     """Build the single-lane program both execution forms share: the
     simulation paradigm vmaps it over all lanes
     (:func:`make_packed_cohort_train`), the cross-silo mesh shard_maps it
-    with a psum tail (:func:`make_crosssilo_packed_round`)."""
+    with a psum tail (:func:`make_crosssilo_packed_round`).
+
+    ``client_transform`` / ``reduce_extras`` are the per-client halves of
+    the cross-silo hook contract (crosssilo.make_crosssilo_round): both
+    take STACKED client results, so the lane applies them at each client's
+    emit step with a singleton leading axis — this is how the whole
+    algorithm zoo (FedOpt/FedNova/AGC/robust) rides the packed schedule."""
     del compute_dtype  # callers pre-cast the stacked arrays once
+    from fedml_tpu.parallel.local import LocalResult
     tx_opt = make_optimizer(optimizer, lr, momentum, wd)
     batch_step = make_batch_sgd_step(
         bundle, task, tx_opt, grad_clip=grad_clip, prox_mu=prox_mu,
@@ -180,7 +189,8 @@ def make_lane_train(
         orders, bkeys = jax.vmap(member_tables)(member_keys, member_row)
 
         def step_fn(carry, xs):
-            variables, opt_state, loss_acc, acc_vars, acc_w, acc_loss, acc_tau = carry
+            (variables, opt_state, loss_acc, acc_vars, acc_w, acc_loss,
+             acc_tau, acc_extras) = carry
             k, e, s, rs, em, lv = xs
             variables = jax.tree.map(
                 lambda v, z: jnp.where(rs > 0, z, v), variables, variables0)
@@ -216,24 +226,57 @@ def make_lane_train(
 
             w = member_w[k] * em
             sr = jnp.maximum(steps_real[k].astype(jnp.float32), 1.0)
-            acc_vars = jax.tree.map(lambda a, v: a + w * v, acc_vars, out_vars)
+            acc_out = out_vars
+            if client_transform is not None:
+                # hook contract is stacked-clients; singleton axis at emit
+                acc_out = jax.tree.map(
+                    lambda v: v[0],
+                    client_transform(
+                        variables0,
+                        jax.tree.map(lambda v: v[None], out_vars)))
+            acc_vars = jax.tree.map(lambda a, v: a + w * v, acc_vars, acc_out)
             acc_w = acc_w + w
             acc_loss = acc_loss + w * loss_acc / sr
             acc_tau = acc_tau + w * epochs * sr
+            if reduce_extras is not None:
+                res1 = LocalResult(
+                    jax.tree.map(lambda v: v[None], out_vars),
+                    (loss_acc / sr)[None], (epochs * sr)[None])
+                # the hook returns WEIGHTED partial sums; w = 0 off-emit,
+                # so non-emit steps contribute exactly nothing. The hook
+                # (like client_transform above) COMPUTES every step even
+                # though only emit steps land — that is O(params) of
+                # elementwise work per step against the step's O(batch x
+                # model) training FLOPs, <0.1% for conv models; buffering
+                # emitted trees and hooking once per member would trade it
+                # for a k_max-sized model buffer per lane and more HBM
+                # traffic than it saves.
+                ex = reduce_extras(variables0, res1, w[None])
+                acc_extras = jax.tree.map(lambda a, b: a + b, acc_extras, ex)
             return (out_vars, new_opt, loss_acc, acc_vars, acc_w, acc_loss,
-                    acc_tau), None
+                    acc_tau, acc_extras), None
 
         # zeros DERIVED from inputs, not constants: under shard_map the
         # inputs are device-varying, and a constant-zero carry init would
         # type-clash with the varying carry the scan body produces
         z = jnp.sum(member_w) * 0.0
         acc0 = jax.tree.map(lambda v: v.astype(jnp.float32) * 0.0, variables0)
-        carry0 = (variables0, opt_state0, z, acc0, z, z, z)
-        (_, _, _, acc_vars, acc_w, acc_loss, acc_tau), _ = jax.lax.scan(
-            step_fn, carry0, (slot, epoch_a, sie, reset, emit, live),
-            unroll=max(int(scan_unroll), 1),
-        )
-        return acc_vars, acc_w, acc_loss, acc_tau
+        if reduce_extras is not None:
+            ex0 = reduce_extras(
+                variables0,
+                LocalResult(jax.tree.map(lambda v: (v * 0.0)[None], variables0),
+                            z[None], z[None]),
+                z[None])
+            acc_extras0 = jax.tree.map(lambda e: e * 0.0, ex0)
+        else:
+            acc_extras0 = {}
+        carry0 = (variables0, opt_state0, z, acc0, z, z, z, acc_extras0)
+        (_, _, _, acc_vars, acc_w, acc_loss, acc_tau, acc_extras), _ = \
+            jax.lax.scan(
+                step_fn, carry0, (slot, epoch_a, sie, reset, emit, live),
+                unroll=max(int(scan_unroll), 1),
+            )
+        return acc_vars, acc_w, acc_loss, acc_tau, acc_extras
 
     return lane_train
 
@@ -283,7 +326,7 @@ def make_packed_cohort_train(
         )(variables, x_flat, y_flat, m_flat, tm,
           member_row, member_keys, member_w, steps_real,
           slot, epoch_a, sie, reset, emit, live)
-        acc_vars, acc_w, acc_loss, acc_tau = lanes
+        acc_vars, acc_w, acc_loss, acc_tau, _extras = lanes
         return (jax.tree.map(lambda a: jnp.sum(a, axis=0), acc_vars),
                 jnp.sum(acc_w), jnp.sum(acc_loss), jnp.sum(acc_tau))
 
@@ -380,6 +423,9 @@ def make_crosssilo_packed_round(
     axis: str = "clients",
     *,
     compute_dtype=None,
+    client_transform: Optional[Callable] = None,
+    reduce_extras: Optional[Callable] = None,
+    server_update: Optional[Callable] = None,
     **lane_kwargs,
 ) -> Callable:
     """Mesh form of the packed schedule: each device runs its lanes (vmap of
@@ -388,19 +434,29 @@ def make_crosssilo_packed_round(
     `make_crosssilo_round_grouped`, with the group-max padding replaced by
     one-batch-granularity lanes.
 
-    Returns ``round_fn(variables, tx, ty, tm, weights, rng, plan_arrays) ->
-    (variables, loss)`` where tx/ty/tm/weights are stacked in PLAN ORDER
-    (device-major perm from `plan_packing_mesh`) and sharded along ``axis``,
-    plan_arrays are the PackPlan arrays (lane axis sharded along ``axis``),
-    and variables/rng are replicated.
+    The three hooks are the cross-silo contract (make_crosssilo_round):
+    client_transform / reduce_extras apply per client at lane emit;
+    server_update runs post-psum on replicated values — so the whole
+    algorithm zoo (FedOpt/FedNova/AGC/robust) rides the packed schedule.
+
+    Returns ``round_fn(variables, server_state, tx, ty, tm, weights, perm,
+    rng, plan_arrays) -> (variables, server_state, loss)`` where
+    tx/ty/tm/weights are stacked in PLAN ORDER (device-major perm from
+    `plan_packing_mesh`) and sharded along ``axis``, plan_arrays are the
+    PackPlan arrays (lane axis sharded along ``axis``), and
+    variables/server_state/rng are replicated.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    lane_train = make_lane_train(bundle, task, n_pad, **lane_kwargs)
+    from fedml_tpu.parallel.crosssilo import apply_server_and_rollback
 
-    def shard_fn(variables, tx, ty, tm, weights, keys, plan_arrays, rng):
-        del rng
+    lane_train = make_lane_train(bundle, task, n_pad,
+                                 client_transform=client_transform,
+                                 reduce_extras=reduce_extras, **lane_kwargs)
+
+    def shard_fn(variables, server_state, tx, ty, tm, weights, keys,
+                 plan_arrays, rng):
         (slot, epoch_a, sie, reset, emit, live,
          member_pos, member_valid, steps_real) = plan_arrays
         variables0 = variables
@@ -414,7 +470,7 @@ def make_crosssilo_packed_round(
         member_keys = keys[member_pos]
         member_w = weights[member_pos] * member_valid
 
-        acc_vars, acc_w, acc_loss, _tau = jax.vmap(
+        acc_vars, acc_w, acc_loss, _tau, acc_extras = jax.vmap(
             lane_train,
             in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
         )(variables, x_flat, y_flat, m_flat, tm,
@@ -426,21 +482,27 @@ def make_crosssilo_packed_round(
         total = jax.lax.psum(jnp.sum(acc_w), axis)
         loss_sum = jax.lax.psum(jnp.sum(acc_loss), axis)
         denom = jnp.maximum(total, 1e-12)
-        keep = total > 0   # elastic all-failed rollback (as _make_mesh_finish)
-        new_vars = jax.tree.map(
-            lambda a, v: jnp.where(keep, (a / denom).astype(v.dtype), v),
-            acc_vars, variables0)
-        return new_vars, loss_sum / denom
+        agg = jax.tree.map(
+            lambda a, v: (a / denom).astype(v.dtype), acc_vars, variables0)
+        extras = None
+        if reduce_extras is not None:
+            extras = jax.tree.map(
+                lambda e: jax.lax.psum(jnp.sum(e, axis=0), axis), acc_extras)
+        new_vars, new_state = apply_server_and_rollback(
+            variables0, agg, extras, total, server_state, rng, server_update)
+        return new_vars, new_state, loss_sum / denom
 
     p_plan = tuple(P(axis) for _ in range(9))
     mapped = shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis), p_plan, P()),
-        out_specs=(P(), P()),
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis),
+                  p_plan, P()),
+        out_specs=(P(), P(), P()),
     )
 
-    def round_fn(variables, tx, ty, tm, weights, perm, rng, plan_arrays):
+    def round_fn(variables, server_state, tx, ty, tm, weights, perm, rng,
+                 plan_arrays):
         """``perm``: the device-major client order from plan_packing_mesh —
         every client keeps the per-round key of its ORIGINAL index (same
         rule as the grouped mesh schedule), so the packing changes only the
@@ -448,6 +510,7 @@ def make_crosssilo_packed_round(
         if compute_dtype is not None and jnp.issubdtype(tx.dtype, jnp.floating):
             tx = tx.astype(compute_dtype)
         keys = jax.random.split(rng, weights.shape[0])[perm]
-        return mapped(variables, tx, ty, tm, weights, keys, plan_arrays, rng)
+        return mapped(variables, server_state, tx, ty, tm, weights, keys,
+                      plan_arrays, rng)
 
     return jax.jit(round_fn)
