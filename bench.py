@@ -109,6 +109,26 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
     from fedml_tpu.models import create_model
     from fedml_tpu.parallel.mesh import client_mesh
 
+    # BENCH_CS_ALGO: measure a zoo algorithm through the same machinery
+    # (the packed schedule carries the cross-silo hooks, so FedOpt/FedNova/
+    # AGC ride it — this knob puts a number on that claim)
+    algo = os.environ.get("BENCH_CS_ALGO", "fedavg")
+    if algo != "fedavg":
+        from fedml_tpu.algorithms.fedagc import CrossSiloFedAGCAPI
+        from fedml_tpu.algorithms.fednova import CrossSiloFedNovaAPI
+        from fedml_tpu.algorithms.fedopt import CrossSiloFedOptAPI
+
+        classes = {
+            "fedopt": CrossSiloFedOptAPI,
+            "fednova": CrossSiloFedNovaAPI,
+            "fedagc": CrossSiloFedAGCAPI,
+        }
+        if algo not in classes:
+            raise ValueError(
+                f"BENCH_CS_ALGO={algo!r}: choose one of "
+                f"{['fedavg', *sorted(classes)]}")
+        CrossSiloFedAvgAPI = classes[algo]
+
     # BENCH_CS_CLIENTS: silo-count override for the weak-scaling fit
     # (docs/perf.md): per-client records stay constant, so round compute
     # scales with the count and T(c) = a + b*c can be fitted from whole runs.
@@ -156,6 +176,7 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
     return {
         "paradigm": "crosssilo shard_map psum, full participation, "
                     "resident-sharded, grouped scan schedule",
+        "algorithm": algo,
         "clients": clients,
         "grouped_schedule": api._group_plan is not None,
         "packed_schedule": api._packed_mesh is not None,

@@ -18,6 +18,18 @@ import numpy as np
 from fedml_tpu.comm import ClientManager, Message, ServerManager
 from fedml_tpu.comm.local import run_ranks
 
+
+def warn_strict_barrier(config, proto: str) -> None:
+    """Log that ``straggler_deadline_sec`` has no effect for ``proto``:
+    unlike fedavg_edge, this protocol keeps the strict all-participants
+    barrier (docs/deploy.md 'Fault tolerance' explains per protocol why it
+    cannot drop participants)."""
+    if getattr(config, "straggler_deadline_sec", None) is not None:
+        logging.getLogger(proto).warning(
+            "straggler_deadline_sec ignored: %s keeps the strict all-"
+            "participants barrier (see docs/deploy.md 'Fault tolerance' "
+            "for why this protocol cannot drop participants)", proto)
+
 LOG = logging.getLogger(__name__)
 
 MSG_TYPE_S2C_INIT = 1

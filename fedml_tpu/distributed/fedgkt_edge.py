@@ -208,6 +208,9 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
     federation. Returns the server manager (history + trained server net via
     ``.api``). Reuses a FedGKTAPI instance as the program/state host so the
     wire run shares init and jitted compute with the simulation."""
+    from fedml_tpu.distributed.base_framework import warn_strict_barrier
+
+    warn_strict_barrier(config, __name__)
     from fedml_tpu.algorithms.fedgkt import FedGKTAPI
 
     codec = getattr(config, "wire_codec", "raw")

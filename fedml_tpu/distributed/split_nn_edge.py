@@ -298,6 +298,9 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
     Each client takes ``config.epochs`` epochs per turn and the ring runs
     one full cycle (turns=1), mirroring the reference defaults. Returns the
     server trainer (val_history, final variables)."""
+    from fedml_tpu.distributed.base_framework import warn_strict_barrier
+
+    warn_strict_barrier(config, __name__)
     from fedml_tpu.core.rng import seed_everything
 
     task = get_task(dataset.task, dataset.class_num)

@@ -297,6 +297,9 @@ def run_turboaggregate_edge(dataset, config, group_size: int = 2,
     """Launch 1 server + num_clients workers over the local transport (or a
     real one via ``comm_factory``) and run the full secure-relay federation.
     Returns the server manager (final ``variables`` + ``history``)."""
+    from fedml_tpu.distributed.base_framework import warn_strict_barrier
+
+    warn_strict_barrier(config, __name__)
     C = min(config.client_num_in_total, dataset.num_clients)
     bundle = create_model(config.model, dataset.class_num,
                           input_shape=dataset.train_x.shape[2:] or None)
