@@ -30,6 +30,60 @@ def warn_strict_barrier(config, proto: str) -> None:
             "participants barrier (see docs/deploy.md 'Fault tolerance' "
             "for why this protocol cannot drop participants)", proto)
 
+
+# Shared straggler-deadline machinery (fedavg_edge + fedgkt_edge; one
+# implementation so the two fault-tolerant protocols cannot drift).
+# Control event injected into the server's OWN receive queue when the
+# deadline fires — never crosses the wire; handling serializes with real
+# message handling on the receive loop.
+MSG_TYPE_LOCAL_ROUND_DEADLINE = 99
+#: consecutive all-dead deadlines before the federation tears itself down
+MAX_EMPTY_DEADLINES = 10
+
+
+def require_injectable(comm, feature: str = "straggler_deadline_sec") -> None:
+    from fedml_tpu.comm import BaseCommunicationManager
+
+    if type(comm).inject_local is BaseCommunicationManager.inject_local:
+        raise ValueError(
+            f"{feature} needs a transport with local event injection "
+            f"(local/grpc); {type(comm).__name__} has none")
+
+
+class RoundDeadlineTimer:
+    """Arms a daemon ``threading.Timer`` that injects a round-tagged
+    LOCAL_ROUND_DEADLINE message into ``comm``'s own delivery queue."""
+
+    def __init__(self, comm, deadline: float, rank: int, round_key: str):
+        self.comm = comm
+        self.deadline = float(deadline)
+        self.rank = int(rank)
+        self.round_key = round_key
+        self._timer = None
+
+    def arm(self, round_idx: int) -> None:
+        import threading
+
+        self.cancel()
+        m = Message(MSG_TYPE_LOCAL_ROUND_DEADLINE, self.rank, self.rank)
+        m.add_params(self.round_key, int(round_idx))
+
+        def fire():
+            try:
+                self.comm.inject_local(m)
+            except Exception as e:   # e.g. receive loop already torn down
+                LOG.warning("deadline timer injection failed: %s", e)
+
+        t = threading.Timer(self.deadline, fire)
+        t.daemon = True
+        t.start()
+        self._timer = t
+
+    def cancel(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
 LOG = logging.getLogger(__name__)
 
 MSG_TYPE_S2C_INIT = 1

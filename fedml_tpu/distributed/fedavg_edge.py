@@ -16,18 +16,12 @@ re-binding, exactly like the reference's client-sampling concurrency model
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Optional
 
 import jax
 import numpy as np
 
-from fedml_tpu.comm import (
-    BaseCommunicationManager,
-    ClientManager,
-    Message,
-    ServerManager,
-)
+from fedml_tpu.comm import ClientManager, Message, ServerManager
 from fedml_tpu.comm.local import run_ranks
 from fedml_tpu.comm.message import (
     MSG_ARG_KEY_CLIENT_INDEX,
@@ -52,8 +46,13 @@ MSG_TYPE_S2C_FINISH = 4
 # reconnected process can re-enter a running federation.
 MSG_TYPE_C2S_JOIN = 5
 # Control event injected into the server's OWN queue when the straggler
-# deadline fires — never crosses the wire.
-MSG_TYPE_LOCAL_ROUND_DEADLINE = 99
+# deadline fires — shared with fedgkt_edge (base_framework).
+from fedml_tpu.distributed.base_framework import (  # noqa: E402
+    MAX_EMPTY_DEADLINES,
+    MSG_TYPE_LOCAL_ROUND_DEADLINE,
+    RoundDeadlineTimer,
+    require_injectable,
+)
 # Round tag: syncs carry the server's round index; uploads echo it so the
 # server can drop stale uploads from workers that fell behind and rejoined.
 MSG_ARG_KEY_ROUND = "round_idx"
@@ -154,18 +153,15 @@ class FedAvgEdgeServerManager(ServerManager):
         self._downlink_image = None
         # fault tolerance (None = reference-strict: wait for all workers)
         self._deadline = getattr(aggregator.config, "straggler_deadline_sec", None)
-        if self._deadline is not None and (
-            type(comm).inject_local is BaseCommunicationManager.inject_local
-        ):
-            raise ValueError(
-                "straggler_deadline_sec needs a transport with local event "
-                f"injection (local/grpc); {type(comm).__name__} has none"
-            )
+        self._deadline_timer = None
+        if self._deadline is not None:
+            require_injectable(comm)
+            self._deadline_timer = RoundDeadlineTimer(
+                comm, self._deadline, rank, MSG_ARG_KEY_ROUND)
         self._alive = {w: True for w in range(size - 1)}
         self._lost_clients: list[int] = []
         self._assignment_map: dict[int, list[int]] = {}
         self._expected: set[int] = set(range(size - 1))
-        self._timer: Optional[threading.Timer] = None
         self._bcast_gen = 0
         # checkpoint/resume (reference: none at all, SURVEY.md §5.4; here
         # the long-running WAN federation — the case that most needs it —
@@ -195,7 +191,7 @@ class FedAvgEdgeServerManager(ServerManager):
         # waiting forever for a rejoin that may never come
         self._empty_deadlines = 0
 
-    _MAX_EMPTY_DEADLINES = 10
+    _MAX_EMPTY_DEADLINES = MAX_EMPTY_DEADLINES
 
     def run(self):
         self.register_message_receive_handlers()
@@ -253,27 +249,12 @@ class FedAvgEdgeServerManager(ServerManager):
         self._expected.discard(w)
 
     def _arm_timer(self) -> None:
-        if self._deadline is None:
-            return
-        self._cancel_timer()
-        tag = self.round_idx
-
-        def fire():
-            m = Message(MSG_TYPE_LOCAL_ROUND_DEADLINE, self.rank, self.rank)
-            m.add_params(MSG_ARG_KEY_ROUND, tag)
-            try:
-                self.com_manager.inject_local(m)
-            except Exception as e:   # e.g. receive loop already torn down
-                LOG.warning("deadline timer injection failed: %s", e)
-
-        self._timer = threading.Timer(self._deadline, fire)
-        self._timer.daemon = True
-        self._timer.start()
+        if self._deadline_timer is not None:
+            self._deadline_timer.arm(self.round_idx)
 
     def _cancel_timer(self) -> None:
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
 
     def handle_round_deadline(self, msg: Message) -> None:
         if self._deadline is None or int(msg.get(MSG_ARG_KEY_ROUND)) != self.round_idx:

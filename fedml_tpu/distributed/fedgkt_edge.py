@@ -37,6 +37,13 @@ MSG_TYPE_S2C_INIT_CONFIG = 1
 MSG_TYPE_S2C_SYNC_TO_CLIENT = 2
 MSG_TYPE_C2S_SEND_FEATURE_AND_LOGITS = 3
 MSG_TYPE_S2C_FINISH = 4
+# straggler-deadline machinery shared with fedavg_edge
+from fedml_tpu.distributed.base_framework import (  # noqa: E402
+    MAX_EMPTY_DEADLINES,
+    MSG_TYPE_LOCAL_ROUND_DEADLINE,
+    RoundDeadlineTimer,
+    require_injectable,
+)
 
 KEY_FEATURE = "feature"
 KEY_LOGITS = "logits"
@@ -63,6 +70,24 @@ class GKTEdgeServerManager(ServerManager):
         self._feat = {}
         self._test = {}
         self.history: list[dict] = []
+        # Fault tolerance (config.straggler_deadline_sec; None = strict
+        # barrier). GKT drops a straggler cleanly because ALL of its
+        # per-client state lives server-side: a missing client's slot is
+        # filled with its LAST-RECEIVED features under a ZERO mask (no
+        # training contribution this round) and its server logits are
+        # carried over, so the server phase shape stays static and a
+        # rejoining client picks up meaningful logits.
+        cfg = api.config
+        self._deadline = getattr(cfg, "straggler_deadline_sec", None)
+        self._deadline_timer = None
+        if self._deadline is not None:
+            require_injectable(comm)
+            self._deadline_timer = RoundDeadlineTimer(
+                comm, self._deadline, rank, KEY_ROUND)
+        self._alive = {k: True for k in range(self.C)}
+        self._last_feat: dict[int, tuple] = {}
+        self._last_test: dict[int, tuple] = {}
+        self._empty_deadlines = 0
         pair = api.pair
 
         @jax.jit
@@ -89,45 +114,158 @@ class GKTEdgeServerManager(ServerManager):
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
             MSG_TYPE_C2S_SEND_FEATURE_AND_LOGITS, self._on_features)
+        self.register_message_receive_handler(
+            MSG_TYPE_LOCAL_ROUND_DEADLINE, self._on_deadline)
 
     def _send_logits(self, msg_type: int):
         slogits = np.asarray(self.api.server_logits)
         for rank in range(1, self.size):
+            if self._deadline is not None and not self._alive[rank - 1]:
+                continue
             m = Message(msg_type, self.rank, rank)
             m.add_params(KEY_GLOBAL_LOGITS, slogits[rank - 1])
             m.add_params(KEY_ROUND, self.round_idx)
-            self.send_message(m)
+            try:
+                self.send_message(m)
+            except Exception as e:
+                if self._deadline is None:
+                    raise
+                log.warning("GKT sync to client %d failed (%s); marking dead",
+                            rank - 1, e)
+                self._alive[rank - 1] = False
+        if self._deadline_timer is not None:
+            self._deadline_timer.arm(self.round_idx)
+
+    def _on_deadline(self, msg: Message):
+        if self._deadline is None or int(msg.get(KEY_ROUND)) != self.round_idx:
+            return
+        missing = [k for k in range(self.C)
+                   if self._alive[k] and k not in self._feat]
+        for k in missing:
+            log.warning("GKT round %d: client %d missed the %.1fs deadline; "
+                        "marking dead", self.round_idx, k, self._deadline)
+            self._alive[k] = False
+        if self._feat:
+            self._empty_deadlines = 0
+            self._complete_round()
+        else:
+            # nothing arrived, so the missing-loop above just marked every
+            # alive client dead (GKT has no JOIN side-channel that could
+            # revive one without populating _feat): wait for a late upload
+            # to rejoin someone, bounded by the shared cap
+            self._empty_deadlines += 1
+            if self._empty_deadlines >= MAX_EMPTY_DEADLINES:
+                log.error("GKT: all clients dead for %d deadlines; tearing "
+                          "down with %d/%d rounds done", self._empty_deadlines,
+                          self.round_idx, self.round_num)
+                self._teardown()
+            elif self._deadline_timer is not None:
+                self._deadline_timer.arm(self.round_idx)
+
+    def _teardown(self):
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        for rank in range(1, self.size):
+            try:
+                self.send_message(Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
+            except Exception as e:
+                if self._deadline is None:
+                    raise
+                log.warning("FINISH to client %d failed (%s)", rank - 1, e)
+        self.finish()
 
     def _on_features(self, msg: Message):
-        if int(msg.get(KEY_ROUND)) != self.round_idx:
+        k = msg.get_sender_id() - 1
+        if self._deadline is not None:
+            self._empty_deadlines = 0
+            if not self._alive.get(k, False):
+                log.info("GKT client %d rejoined at round %d", k, self.round_idx)
+                self._alive[k] = True
+                if int(msg.get(KEY_ROUND)) != self.round_idx:
+                    # stale upload: catch the client up with the CURRENT
+                    # round's logits so it can take part right away
+                    m = Message(MSG_TYPE_S2C_SYNC_TO_CLIENT, self.rank, k + 1)
+                    m.add_params(KEY_GLOBAL_LOGITS,
+                                 np.asarray(self.api.server_logits)[k])
+                    m.add_params(KEY_ROUND, self.round_idx)
+                    try:
+                        self.send_message(m)
+                    except Exception as e:
+                        log.warning("GKT catch-up to client %d failed (%s)",
+                                    k, e)
+                        self._alive[k] = False
+                    return
+            if int(msg.get(KEY_ROUND)) != self.round_idx:
+                return   # stale upload from a round that already closed
+        elif int(msg.get(KEY_ROUND)) != self.round_idx:
             raise RuntimeError(
                 f"GKT features for round {msg.get(KEY_ROUND)} arrived at "
                 f"server in round {self.round_idx}")
-        k = msg.get_sender_id() - 1
         self._feat[k] = tuple(np.asarray(msg.get(key)) for key in
                               (KEY_FEATURE, KEY_LOGITS, KEY_LABELS, KEY_MASK))
         self._test[k] = tuple(np.asarray(msg.get(key)) for key in
                               (KEY_FEATURE_TEST, KEY_LABELS_TEST,
                                KEY_MASK_TEST))
-        if len(self._feat) < self.C:
+        expected = ({k for k in range(self.C) if self._alive[k]}
+                    if self._deadline is not None else set(range(self.C)))
+        if not expected <= set(self._feat):
             return
+        self._complete_round()
+
+    def _complete_round(self):
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
         api = self.api
-        order = sorted(self._feat)
+        received = sorted(self._feat)
+        for k in received:
+            self._last_feat[k] = self._feat[k]
+            self._last_test[k] = self._test[k]
+        template = self._feat[received[0]]
+
+        def slot(k):
+            """A missing client's slot: its LAST-RECEIVED features under a
+            ZERO mask (no training contribution), or an all-zero slot if it
+            died before ever uploading — the stack shape stays the static
+            [C, ...] the server program was compiled for either way."""
+            if k in self._feat:
+                return self._feat[k]
+            if k in self._last_feat:
+                f, l, y, m = self._last_feat[k]
+                return f, l, y, np.zeros_like(m)
+            return tuple(np.zeros_like(t) for t in template)
+
+        order = list(range(self.C))
         feats, clogits, ys, masks = (
-            np.stack([self._feat[i][j] for i in order]) for j in range(4))
+            np.stack([slot(i)[j] for i in order]) for j in range(4))
         rkey = round_key(api.root_key, self.round_idx)
-        (api.server_vars, api.server_opt, api.server_logits, sloss) = (
+        (api.server_vars, api.server_opt, new_logits, sloss) = (
             api._server_phase(
                 api.server_vars, api.server_opt, jnp.asarray(feats),
                 jnp.asarray(ys), jnp.asarray(masks), jnp.asarray(clogits),
                 jax.random.fold_in(rkey, 2),
             )
         )
+        if len(received) == self.C:
+            # healthy path (and the whole strict mode): every slot is
+            # fresh — assign the jit output directly, no host round-trip
+            api.server_logits = new_logits
+        else:
+            # scatter fresh logits back by client id; a missing client
+            # keeps its previous logits (its slot's output came from stale
+            # or zero inputs)
+            merged = np.asarray(api.server_logits).copy()
+            fresh = np.asarray(new_logits)
+            for k in received:
+                merged[k] = fresh[k]
+            api.server_logits = jnp.asarray(merged)
         cfg = api.config
         if (self.round_idx % cfg.frequency_of_the_test == 0
                 or self.round_idx == self.round_num - 1):
+            torder = [k for k in order if k in self._last_test or k in self._test]
             tfeats, tys, tms = (
-                jnp.asarray(np.stack([self._test[i][j] for i in order]))
+                jnp.asarray(np.stack([
+                    (self._test.get(i) or self._last_test[i])[j]
+                    for i in torder]))
                 for j in range(3))
             sums = jax.device_get(
                 self._evaluate_feats(api.server_vars, tfeats, tys, tms))
@@ -142,9 +280,7 @@ class GKTEdgeServerManager(ServerManager):
         self._test.clear()
         self.round_idx += 1
         if self.round_idx >= self.round_num:
-            for rank in range(1, self.size):
-                self.send_message(Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
-            self.finish()
+            self._teardown()
         else:
             self._send_logits(MSG_TYPE_S2C_SYNC_TO_CLIENT)
 
@@ -208,9 +344,6 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
     federation. Returns the server manager (history + trained server net via
     ``.api``). Reuses a FedGKTAPI instance as the program/state host so the
     wire run shares init and jitted compute with the simulation."""
-    from fedml_tpu.distributed.base_framework import warn_strict_barrier
-
-    warn_strict_barrier(config, __name__)
     from fedml_tpu.algorithms.fedgkt import FedGKTAPI
 
     codec = getattr(config, "wire_codec", "raw")
@@ -231,6 +364,29 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
         lambda cv, tx: api.pair.client.apply_eval(cv, tx)[1])
     tx_, ty_, tm_ = api._test_shards
     size = api.C + 1
+
+    if getattr(config, "straggler_deadline_sec", None) is not None:
+        # Fault-tolerant mode: absorb the jit compiles BEFORE the deadline
+        # clock can run — a first round slowed by compilation must not get
+        # healthy clients marked dead. All three programs are functional;
+        # the warmup outputs are discarded.
+        cv0 = jax.tree.map(lambda v: v[0], api.client_vars)
+        co0 = jax.tree.map(lambda v: v[0], api.client_opt)
+        res = train_one(
+            cv0, co0, jnp.asarray(dataset.train_x[0]),
+            jnp.asarray(dataset.train_y[0]), jnp.asarray(dataset.train_mask[0]),
+            jnp.asarray(dataset.train_counts[0], jnp.float32),
+            api.server_logits[0], jnp.float32(0.0),
+            jax.random.fold_in(api.root_key, 0))
+        feats0 = jax.block_until_ready(res[2])
+        jax.block_until_ready(extract_test(cv0, jnp.asarray(tx_[0])))
+        C = api.C
+        jax.block_until_ready(api._server_phase(
+            api.server_vars, api.server_opt,
+            jnp.broadcast_to(feats0, (C,) + feats0.shape),
+            jnp.asarray(dataset.train_y), jnp.asarray(dataset.train_mask),
+            jnp.broadcast_to(res[3], (C,) + res[3].shape),
+            jax.random.fold_in(api.root_key, 1))[3])
 
     class Args:
         pass

@@ -1,0 +1,141 @@
+"""Fault-tolerant FedGKT edge rounds (--straggler_deadline_sec).
+
+GKT drops a straggler cleanly because all per-client state lives
+server-side: a missing client's slot is filled with its last-received
+features under a ZERO mask and its server logits carry over, so the server
+phase keeps its static shape and trains only on fresh data. These tests pin
+that behavior plus bit-identity of a healthy fault-tolerant run with the
+strict barrier.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import fedml_tpu.distributed.fedgkt_edge as fe
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.synthetic import make_synthetic_classification
+
+C = 3
+
+
+def _ds():
+    return make_synthetic_classification(
+        "gkt-ft", (8, 8, 3), 3, C, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=3,
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        model="lr", dataset="synthetic", client_num_in_total=C,
+        client_num_per_round=C, comm_round=4, epochs=1, epochs_server=1,
+        batch_size=4, lr=0.05, seed=5, frequency_of_the_test=1,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _run(ds, cfg, client_cls=None, monkeypatch=None):
+    if client_cls is not None:
+        monkeypatch.setattr(fe, "GKTEdgeClientManager", client_cls)
+    return fe.run_fedgkt_edge(ds, cfg, client_blocks=1,
+                              server_blocks_per_stage=1)
+
+
+def test_gkt_ft_healthy_matches_strict(monkeypatch):
+    ds = _ds()
+    strict = _run(ds, _cfg())
+    ft = _run(ds, _cfg(straggler_deadline_sec=60.0))
+    assert [h["Test/Acc"] for h in ft.history] == \
+           [h["Test/Acc"] for h in strict.history]
+    assert [h["Test/Loss"] for h in ft.history] == \
+           [h["Test/Loss"] for h in strict.history]
+
+
+def test_gkt_straggler_dropped_run_completes(monkeypatch):
+    """Client 2 (rank 3) goes silent from round 1: the server's deadline
+    closes every round with the received subset, the dead client's slot
+    trains under a zero mask, and the federation finishes all rounds."""
+
+    class Silent(fe.GKTEdgeClientManager):
+        def _on_sync(self, msg):
+            if self.rank == 3 and int(msg.get(fe.KEY_ROUND)) >= 1:
+                return   # never replies again (a dead process)
+            super()._on_sync(msg)
+
+    ds = _ds()
+    server = _run(ds, _cfg(straggler_deadline_sec=8.0), Silent, monkeypatch)
+    hist = server.history
+    assert [h["round"] for h in hist] == list(range(4))
+    assert all(np.isfinite(h["Test/Loss"]) for h in hist)
+    assert server._alive == {0: True, 1: True, 2: False}
+
+
+def test_gkt_client_dead_from_round_zero(monkeypatch):
+    """A client that NEVER uploads (dead before round 0 closed): its slot
+    is all-zero under a zero mask — the server stack keeps its static
+    shape and the federation completes every round."""
+
+    class DeadFromStart(fe.GKTEdgeClientManager):
+        def _on_sync(self, msg):
+            if self.rank == 3:
+                return
+            super()._on_sync(msg)
+
+    ds = _ds()
+    server = _run(ds, _cfg(straggler_deadline_sec=8.0), DeadFromStart,
+                  monkeypatch)
+    hist = server.history
+    assert [h["round"] for h in hist] == list(range(4))
+    assert all(np.isfinite(h["Test/Loss"]) for h in hist)
+    assert server._alive[2] is False
+
+
+def test_gkt_late_straggler_rejoins(monkeypatch):
+    """EVERY client's round-1 reply arrives after the deadline: the round
+    stalls in the all-dead wait loop, the late (stale) uploads mark the
+    clients alive again, the catch-up syncs restart the round, and the
+    federation completes with everyone participating."""
+
+    class Slow(fe.GKTEdgeClientManager):
+        def _on_sync(self, msg):
+            if int(msg.get(fe.KEY_ROUND)) == 1:
+                time.sleep(16.0)   # well past the deadline
+            super()._on_sync(msg)
+
+    ds = _ds()
+    # deadline must clear round 0's jit compile; the sleep must clear the
+    # deadline with margin
+    server = _run(ds, _cfg(straggler_deadline_sec=8.0, comm_round=5),
+                  Slow, monkeypatch)
+    hist = server.history
+    assert [h["round"] for h in hist] == list(range(5))
+    assert server._alive == {0: True, 1: True, 2: True}   # rejoined
+    assert all(np.isfinite(h["Test/Loss"]) for h in hist)
+
+
+def test_gkt_deadline_requires_injectable_transport():
+    from fedml_tpu.algorithms.fedgkt import FedGKTAPI
+    from fedml_tpu.comm import BaseCommunicationManager
+
+    class NoInject(BaseCommunicationManager):
+        def send_message(self, m):
+            pass
+
+        def handle_receive_message(self):
+            pass
+
+        def stop_receive_message(self):
+            pass
+
+    ds = _ds()
+    api = FedGKTAPI(ds, _cfg(straggler_deadline_sec=5.0), client_blocks=1,
+                    server_blocks_per_stage=1)
+
+    class Args:
+        comm_round = 2
+
+    with pytest.raises(ValueError, match="local event injection"):
+        fe.GKTEdgeServerManager(Args(), NoInject(), 0, C + 1, api)
