@@ -97,3 +97,30 @@ def test_packed_fedopt_server_state_persists_across_rounds():
 
     leaves = jax.tree.leaves(api.server_state)
     assert leaves and any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
+
+
+def test_packed_fedseg_matches_sim():
+    """Segmentation task family through the packed lanes (per-pixel loss /
+    confusion-matrix eval) — FedSeg inherits the plain weighted mean, so
+    the packed mesh round must match the simulation run."""
+    from fedml_tpu.algorithms.fedseg import CrossSiloFedSegAPI, FedSegAPI
+    from fedml_tpu.data.segmentation import make_synthetic_segmentation
+
+    ds = make_synthetic_segmentation(
+        num_clients=16, records_per_client=12, image_size=16, num_classes=3,
+        batch_size=4, seed=7)
+    kw = dict(model="unet", dataset="seg", client_num_in_total=16,
+              client_num_per_round=16, comm_round=2, batch_size=4, lr=0.1,
+              frequency_of_the_test=1, seed=3)
+    mesh_api = CrossSiloFedSegAPI(ds, FedConfig(
+        pack_lanes=8, device_data="on", bucket_quantum_batches=1, **kw))
+    assert mesh_api._packed_mesh is not None
+    hm = mesh_api.train()
+    # sim baseline: canonical unbucketed schedule, like _sim_cfg
+    hs = FedSegAPI(ds, FedConfig(
+        pack_lanes=0, device_data="off", bucket_quantum_batches=0, **kw)).train()
+    # conv net: vmapped-lane vs sim reduction orders diverge a few 1e-4
+    # after an aggregation round (round 0 matches exactly); the lr-model
+    # zoo tests above hold the tight 5e-5 line
+    np.testing.assert_allclose(hm["Test/Loss"], hs["Test/Loss"], rtol=2e-3)
+    np.testing.assert_allclose(hm["Test/Acc"], hs["Test/Acc"], rtol=2e-3)
