@@ -88,6 +88,29 @@ class GKTEdgeServerManager(ServerManager):
         self._last_feat: dict[int, tuple] = {}
         self._last_test: dict[int, tuple] = {}
         self._empty_deadlines = 0
+        # checkpoint/resume (mirrors fedavg_edge): server-side GKT state is
+        # server_vars/opt/logits + round + history; client state persists
+        # per client next to it (run_fedgkt_edge plumbs the paths)
+        self._ckpt_path = None
+        if getattr(cfg, "checkpoint_dir", None):
+            import os
+
+            os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+            self._ckpt_path = os.path.join(cfg.checkpoint_dir, "gkt_server.ckpt")
+        self._ckpt_freq = int(getattr(cfg, "checkpoint_frequency", 10) or 10)
+        resume = getattr(cfg, "resume_from", None)
+        if resume:
+            from fedml_tpu.utils.checkpoint import load_checkpoint
+
+            state = load_checkpoint(resume)
+            t = state["variables"]
+            api.server_vars = t["server_vars"]
+            api.server_opt = t["server_opt"]
+            api.server_logits = jnp.asarray(t["server_logits"])
+            self.round_idx = int(state["round_idx"])
+            self.history.extend(state["extra"].get("history", []))
+            log.info("resumed GKT federation at round %d from %s",
+                     self.round_idx, resume)
         pair = api.pair
 
         @jax.jit
@@ -108,8 +131,28 @@ class GKTEdgeServerManager(ServerManager):
 
     def run(self):
         self.register_message_receive_handlers()
+        if self.round_idx >= self.round_num:   # resumed a finished run
+            self._teardown()
+            return
         self._send_logits(MSG_TYPE_S2C_INIT_CONFIG)
         self.com_manager.handle_receive_message()
+
+    def _maybe_checkpoint(self):
+        if self._ckpt_path is None:
+            return
+        if (self.round_idx % self._ckpt_freq == 0
+                or self.round_idx >= self.round_num):
+            from fedml_tpu.utils.checkpoint import save_checkpoint
+
+            # history entries are already plain floats/ints (built via
+            # float() in _complete_round) — JSON-safe as-is
+            save_checkpoint(
+                self._ckpt_path,
+                {"server_vars": self.api.server_vars,
+                 "server_opt": self.api.server_opt,
+                 "server_logits": self.api.server_logits},
+                round_idx=self.round_idx,
+                extra={"history": list(self.history)})
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
@@ -279,6 +322,7 @@ class GKTEdgeServerManager(ServerManager):
         self._feat.clear()
         self._test.clear()
         self.round_idx += 1
+        self._maybe_checkpoint()
         if self.round_idx >= self.round_num:
             self._teardown()
         else:
@@ -291,7 +335,8 @@ class GKTEdgeClientManager(ClientManager):
 
     def __init__(self, args, comm, rank, size, *, train_one, extract_test,
                  root_key, cvars, copt, x, y, mask, count, test_x, test_y,
-                 test_mask, alpha_distill):
+                 test_mask, alpha_distill, state_path=None, resume=False,
+                 state_every=10):
         super().__init__(args, comm, rank, size)
         # train_one/extract arrive ALREADY jitted and shared across the C
         # managers (jitted functions are thread-safe): one compile serves
@@ -304,6 +349,27 @@ class GKTEdgeClientManager(ClientManager):
         self.test_x, self.test_y, self.test_mask = test_x, test_y, test_mask
         self.alpha_distill = alpha_distill
         self.C = size - 1
+        # per-client state persistence: unlike FedAvg (whose workers get the
+        # model in every sync), GKT clients OWN their small-net weights —
+        # resume must restore them or the federation restarts distillation
+        # from scratch
+        self._state_path = state_path
+        self._state_every = max(int(state_every), 1)
+        self._state_round = None
+        self._init_state = (cvars, copt)
+        if resume and state_path is not None:
+            import os
+
+            if os.path.exists(state_path):
+                from fedml_tpu.core.serialization import tree_from_bytes
+
+                with open(state_path, "rb") as f:
+                    st = tree_from_bytes(f.read())
+                self.cvars = st["cvars"]
+                self.copt = st["copt"]
+                self._state_round = int(np.asarray(st["round"]).item())
+                log.info("GKT client %d resumed local state for round %d",
+                         rank - 1, self._state_round)
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
@@ -313,6 +379,19 @@ class GKTEdgeClientManager(ClientManager):
 
     def _on_sync(self, msg: Message):
         rnd = int(msg.get(KEY_ROUND))
+        if self._state_round is not None:
+            # discard only FUTURE-tagged state (server resumed from an
+            # older checkpoint than this client's save). A PAST tag is the
+            # normal straggler/dead-client case: the uninterrupted run
+            # would have it rejoin with exactly those weights (the server
+            # carries its old logits for the same reason), so keep them.
+            if self._state_round > rnd:
+                log.warning(
+                    "GKT client %d: resumed state targets future round %d "
+                    "but federation is at round %d; discarding it",
+                    self.rank - 1, self._state_round, rnd)
+                self.cvars, self.copt = self._init_state
+            self._state_round = None
         slogits = jnp.asarray(np.asarray(msg.get(KEY_GLOBAL_LOGITS)))
         # same derivations as the simulation's client phase: kl_w gates the
         # distillation term off in round 0, and client k consumes key
@@ -334,6 +413,24 @@ class GKTEdgeClientManager(ClientManager):
         out.add_params(KEY_MASK_TEST, np.asarray(self.test_mask))
         out.add_params(KEY_ROUND, rnd)
         self.send_message(out)
+        # persist ONLY at the server's checkpoint boundaries, so the
+        # on-disk client state always matches a server checkpoint — a
+        # kill between boundaries then resumes both sides consistently
+        # from the same round instead of pairing a boundary server with
+        # newer client nets (which resume would have to discard)
+        if self._state_path is not None and (
+                (rnd + 1) % self._state_every == 0
+                or rnd + 1 >= int(self.args.comm_round)):
+            import os
+
+            from fedml_tpu.core.serialization import tree_to_bytes
+
+            tmp = self._state_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(tree_to_bytes({
+                    "cvars": self.cvars, "copt": self.copt,
+                    "round": np.int64(rnd + 1)}))
+            os.replace(tmp, self._state_path)
 
 
 def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
@@ -394,7 +491,20 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
     args = Args()
     args.comm_round = config.comm_round
 
+    import os as _os
+
+    resume_from = getattr(config, "resume_from", None)
+    ckpt_dir = getattr(config, "checkpoint_dir", None)
+    if ckpt_dir is None and resume_from:
+        # resuming without writing new checkpoints: the per-client state
+        # lives next to the server checkpoint being resumed
+        ckpt_dir = _os.path.dirname(_os.path.abspath(resume_from))
+    resume = bool(resume_from)
+    ckpt_freq = int(getattr(config, "checkpoint_frequency", 10) or 10)
+
     def make(rank, comm):
+        import os
+
         if rank == 0:
             return GKTEdgeServerManager(args, comm, rank, size, api)
         k = rank - 1
@@ -410,6 +520,9 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
             test_x=jnp.asarray(tx_[k]), test_y=np.asarray(ty_[k]),
             test_mask=np.asarray(tm_[k]),
             alpha_distill=config.alpha_distill,
+            state_path=(os.path.join(ckpt_dir, f"gkt_client_{k}.state")
+                        if ckpt_dir else None),
+            resume=resume, state_every=ckpt_freq,
         )
 
     # GKT's payloads are the framework's biggest (per-sample feature maps +

@@ -139,3 +139,42 @@ def test_gkt_deadline_requires_injectable_transport():
 
     with pytest.raises(ValueError, match="local event injection"):
         fe.GKTEdgeServerManager(Args(), NoInject(), 0, C + 1, api)
+
+
+def test_gkt_edge_kill_and_resume_bit_identical(tmp_path):
+    """GKT edge checkpoint/resume: server (server_vars/opt/logits + round +
+    history) AND per-client small-net state persist, so a federation
+    resumed at the checkpoint boundary produces EXACTLY the uninterrupted
+    run's history — the same standard test_edge_checkpoint.py pins for
+    FedAvg."""
+    ds = _ds()
+    full = _run(ds, _cfg(comm_round=6))
+
+    ckpt_dir = str(tmp_path / "gkt_ckpt")
+    _run(ds, _cfg(comm_round=3, checkpoint_dir=ckpt_dir,
+                  checkpoint_frequency=3))
+    import os
+
+    ckpt = os.path.join(ckpt_dir, "gkt_server.ckpt")
+    assert os.path.exists(ckpt)
+    assert os.path.exists(os.path.join(ckpt_dir, "gkt_client_0.state"))
+
+    resumed = _run(ds, _cfg(comm_round=6, checkpoint_dir=ckpt_dir,
+                            checkpoint_frequency=3, resume_from=ckpt))
+    assert [h["round"] for h in resumed.history] == \
+           [h["round"] for h in full.history]
+    np.testing.assert_array_equal(
+        [h["Test/Acc"] for h in resumed.history],
+        [h["Test/Acc"] for h in full.history])
+    np.testing.assert_array_equal(
+        [h["Test/Loss"] for h in resumed.history],
+        [h["Test/Loss"] for h in full.history])
+
+    # resume WITHOUT --checkpoint_dir: the client state is found next to
+    # the server checkpoint, so the result is STILL bit-identical (a
+    # silent client restart-from-init would diverge here)
+    resumed2 = _run(ds, _cfg(comm_round=6, checkpoint_frequency=3,
+                             resume_from=ckpt))
+    np.testing.assert_array_equal(
+        [h["Test/Acc"] for h in resumed2.history],
+        [h["Test/Acc"] for h in full.history])
