@@ -158,7 +158,8 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
     )
     bundle = create_model(model, 10, dtype=jnp.bfloat16,
                           input_shape=ds.train_x.shape[2:],
-                          bn_impl=os.environ.get("BENCH_BN", "xla"))
+                          bn_impl=os.environ.get("BENCH_BN", "xla"),
+                          conv_impl=os.environ.get("BENCH_CONV", "xla"))
     api = CrossSiloFedAvgAPI(ds, cfg, bundle, mesh=client_mesh(1))
     for r in range(1, rounds + 1):
         last = api.run_round(r)
@@ -238,7 +239,8 @@ def main():
     )
     bundle = create_model(model, 10, dtype=jnp.bfloat16,
                           input_shape=ds.train_x.shape[2:],
-                          bn_impl=os.environ.get("BENCH_BN", "xla"))
+                          bn_impl=os.environ.get("BENCH_BN", "xla"),
+                          conv_impl=os.environ.get("BENCH_CONV", "xla"))
     api = FedAvgAPI(ds, cfg, bundle)
 
     # Warmup pass: run every measured round once so each distinct cohort

@@ -531,10 +531,15 @@ class FedAvgAPI:
                 and self._packing_supported()):
             pk = self._packed_plan(sampled)
             if pk is not None:
-                # packed lanes execute T batch-steps each, every epoch —
-                # report ONE epoch's slots (real counts are per-epoch too)
-                padded = pk.executed_slots * self.config.batch_size \
-                    // max(self.config.epochs, 1)
+                # Per-epoch slots straight from the plan (advisor r4 #3):
+                # each epoch executes every member's real steps once; the
+                # dead lane-tail slots (T*lanes - epochs*real) run once per
+                # ROUND and are amortized over epochs — exact at epochs=1
+                # (the bench recipe), off by < epochs slots otherwise.
+                ep = max(self.config.epochs, 1)
+                real_steps = int((pk.steps_real * pk.member_valid).sum())
+                tail = pk.n_lanes * pk.T - ep * real_steps
+                padded = (real_steps + round(tail / ep)) * self.config.batch_size
                 return int(counts.sum()), int(padded)
         plan = self._round_groups(sampled, live) if self._dev_train is not None else None
         if plan is not None:

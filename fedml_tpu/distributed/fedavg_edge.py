@@ -313,6 +313,7 @@ class FedAvgEdgeServerManager(ServerManager):
             self._downlink_image = global_params
         self._expected = set()
         self._bcast_gen += 1
+        msgs = []
         for w in sorted(assignments):
             if not self._alive[w]:
                 continue
@@ -322,16 +323,38 @@ class FedAvgEdgeServerManager(ServerManager):
             m.add_params(MSG_ARG_KEY_CLIENT_INDEX, assignments[w])
             m.add_params(MSG_ARG_KEY_ROUND, self.round_idx)
             m.add_params(MSG_ARG_KEY_GEN, self._bcast_gen)
-            try:
-                self.send_message(m)
-            except Exception as e:
-                if self._deadline is None:
-                    raise
-                # dead peer: a blocked/failed send must not stall the round
-                LOG.warning("send to worker %d failed (%s)", w, e)
-                self._mark_dead(w)
-                continue
-            self._expected.add(w)
+            msgs.append((w, m))
+        if self._deadline is not None and len(msgs) > 1:
+            # Concurrent sends (advisor r4 #4): each gRPC send blocks up to
+            # the straggler deadline on an unreachable-but-not-yet-dead
+            # peer, so W stragglers would stall a sequential loop W*deadline
+            # — overlapping them caps the broadcast at ~one deadline total.
+            from concurrent.futures import ThreadPoolExecutor
+
+            # one thread per send: each blocked send can hold its thread
+            # for the full deadline, so any smaller pool re-serializes the
+            # stall in waves (review r5 #2)
+            with ThreadPoolExecutor(max_workers=len(msgs)) as ex:
+                futs = [(w, ex.submit(self.send_message, m)) for w, m in msgs]
+                results = [(w, f.exception()) for w, f in futs]
+            for w, err in results:
+                if err is None:
+                    self._expected.add(w)
+                else:
+                    LOG.warning("send to worker %d failed (%s)", w, err)
+                    self._mark_dead(w)
+        else:
+            for w, m in msgs:
+                try:
+                    self.send_message(m)
+                except Exception as e:
+                    if self._deadline is None:
+                        raise
+                    # dead peer: a blocked send must not stall the round
+                    LOG.warning("send to worker %d failed (%s)", w, e)
+                    self._mark_dead(w)
+                    continue
+                self._expected.add(w)
         self._arm_timer()
 
     def send_init_msg(self):

@@ -29,20 +29,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-def _sds(shape, dtype, like):
-    """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` — under
-    shard_map (the cross-silo mesh round) pallas outputs must declare how
-    they vary across the mesh; outside shard_map vma is empty and harmless."""
-    try:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
-    except (AttributeError, TypeError):
-        return jax.ShapeDtypeStruct(shape, dtype)
-
-
-def _interpret() -> bool:
-    """Pallas TPU kernels run in interpret mode on CPU backends (unit
-    tests / virtual meshes); compiled on real TPUs."""
-    return jax.default_backend() != "tpu"
+from fedml_tpu.ops.common import interpret as _interpret
+from fedml_tpu.ops.common import sds as _sds
 
 
 def _fwd_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, rstd_ref,
@@ -193,7 +181,7 @@ def _fwd(x, gamma, beta, eps, relu):
     if chunk is None:
         y, mean, rstd = _xla_bn_relu(x.reshape(n, C), gamma, beta, eps, relu)
         return (y.reshape(orig_shape), mean, rstd,
-                (x.reshape(n, C), gamma, mean, rstd, y, 1))
+                (x.reshape(n, C), gamma, mean, rstd, y, 1, None))
     n_chunks = rows // chunk
 
     kernel = partial(_fwd_kernel, n_rows=float(n), eps=float(eps), relu=relu,
@@ -220,7 +208,7 @@ def _fwd(x, gamma, beta, eps, relu):
         interpret=_interpret(),
     )(xf, jnp.tile(gamma, G).reshape(1, Ce), jnp.tile(beta, G).reshape(1, Ce))
     return (y.reshape(orig_shape), mean.reshape(C), rstd.reshape(C),
-            (xf, gamma, mean.reshape(C), rstd.reshape(C), y, G))
+            (xf, gamma, mean.reshape(C), rstd.reshape(C), y, G, chunk))
 
 
 def _fused_fwd(x, gamma, beta, eps, relu):
@@ -231,14 +219,16 @@ def _fused_fwd(x, gamma, beta, eps, relu):
 
 def _fused_bwd(eps, relu, res, cts):
     dy_full, _dmean, _dvar = cts   # stats gradients are not propagated
-    xf, gamma, mean, rstd, y, G = res
+    # ``chunk`` is the forward's own tiling decision (None = XLA fallback,
+    # which stores G=1 and [n, C] residuals) — recorded rather than
+    # re-derived so the two passes cannot disagree (advisor r4 #2).
+    xf, gamma, mean, rstd, y, G, chunk = res
     rows, Ce = xf.shape
     C = gamma.shape[-1]
     n = rows * G
     orig_shape = dy_full.shape
     dyf = dy_full.reshape(rows, Ce)
-    chunk = _chunk_for(rows)
-    if chunk is None:   # fwd used the XLA fallback (G == 1 by construction)
+    if chunk is None:   # fwd used the XLA fallback
         dy = dyf.astype(jnp.float32)
         if relu:
             dy = dy * (y.astype(jnp.float32) > 0.0)

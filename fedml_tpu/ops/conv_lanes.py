@@ -1,0 +1,304 @@
+"""Spatial-in-lanes 3x3 convolution as a Pallas TPU kernel.
+
+COMMITTED NEGATIVE RESULT — kept as the measured experiment + instrument
+(docs/mfu_experiments.md H6; bench A/B: 14.2k vs 28.3k real img/s).
+
+Hypothesis (docs/mfu_experiments.md H1/H4, VERDICT r4 #1): XLA's TPU conv
+lowering maps C_out to the MXU's 128-wide lane dimension, so the flagship
+ResNet-56's stage-1/2 convs (C=16/32) idle 7/8 and 3/4 of the lanes; this
+kernel transposes the mapping:
+
+    Y'[C_out, P] = W2[C_out, 9*C_in] @ Patches[9*C_in, P]
+
+with P = output PIXELS in the lane dimension (always full) and C_out in
+the SUBLANE dimension (granularity 8). Pass-count arithmetic promised 8x
+at C=16 / 4x at C=32; C=64 breaks even, so stage 3 stays on XLA.
+
+What measurement showed (tools/lanes_probe.py): the patch build is cheap
+(6.5 us of 33) but the GEMM's STREAMED dimension is now M = C_out = 16,
+so every MXU tile pays pipeline fill/drain over 2 registers — the conv's
+output matrix [C_out, pixels] has one small dimension in ANY single-GEMM
+mapping, and XLA's choice (stream pixels, idle lanes) is the faster
+corner: 12 us/conv = 12.7% MFU at C=16, vs 33 us for this kernel. The
+hardware floor at small C is streaming geometry, not lane occupancy.
+
+The patch matrix is built in VMEM per grid step from 9 shifted lane-slices
+of a row-padded image buffer — nothing is materialized in HBM (an im2col
+through HBM would be bandwidth-dead: 9x activation traffic). Row padding
+(one zero image-row before and after, plus one lane each end) makes every
+tap a simple in-bounds slice; the x-direction edge wrap is masked with a
+static (lane mod W) mask per dx.
+
+Layout contract: activations travel as [N, C, H*W] ("lanes layout") so
+the abundant H*W axis owns the lanes for every surrounding elementwise/BN
+op too (flax BatchNorm with axis=1). models/resnet.py opts in via
+``conv_impl='lanes'``.
+
+Counterpart in the reference: none — fedml_api's torch models call cuDNN
+(reference fedml_api/model/cv/resnet.py); this is the TPU-native answer to
+the same conv workload.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TAPS = tuple((dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1))
+
+# Largest pixel-tile (lane-dim length) per grid step. 2048 keeps the patch
+# scratch comfortably in VMEM at C=32 (9*32 x 2048 bf16 = 1.1 MB).
+MAX_TILE = 2048
+
+
+from fedml_tpu.ops.common import interpret as _interpret
+from fedml_tpu.ops.common import sds as _sds
+
+
+def supported(c_in: int, h: int, w: int) -> bool:
+    """Shapes the kernel handles; callers fall back to XLA otherwise.
+    C_in must respect sublane granularity (patch rows sit at offsets
+    t*C_in), and the derived lane tile must be a multiple of W — the
+    static edge masks assume every tile starts at an image-row boundary
+    (lane l's x-coord is l % W only then)."""
+    hw = h * w
+    if c_in % 8 or hw % 128 or (hw > MAX_TILE and hw % MAX_TILE):
+        return False
+    return _tile(hw) % w == 0
+
+
+def _tile(hw: int) -> int:
+    t = hw
+    while t > MAX_TILE:
+        t //= 2
+    return t
+
+
+def _w2(w: jnp.ndarray) -> jnp.ndarray:
+    """[3,3,Ci,Co] -> [Co, 9*Ci] matching patch-row order (tap-major)."""
+    k3, _, ci, co = w.shape
+    taps = k3 * k3
+    return w.reshape(taps, ci, co).transpose(2, 0, 1).reshape(co, taps * ci)
+
+
+def _w2_inv(dw2: jnp.ndarray, ci: int, co: int) -> jnp.ndarray:
+    """[Co, 9*Ci] -> [3,3,Ci,Co] (inverse of _w2)."""
+    return dw2.reshape(co, 9, ci).transpose(1, 2, 0).reshape(3, 3, ci, co)
+
+
+def _pad_rows(xf: jnp.ndarray, w: int) -> jnp.ndarray:
+    """[N, C, H*W] -> [N, C, (H+2)*W + 2]: one zero image-row before and
+    after plus one lane each end, so every tap offset is in-bounds.
+    B[1 + W + p] = X[p]."""
+    return jnp.pad(xf, ((0, 0), (0, 0), (w + 1, w + 1)))
+
+
+def _col_masks(w: int, t: int):
+    """Static edge masks over the lane dim: lane l has x-coord l%W because
+    tile starts are multiples of W."""
+    x = jax.lax.broadcasted_iota(jnp.int32, (1, t), 1) % w
+    return {-1: x != 0, 0: None, 1: x != (w - 1)}
+
+
+def _build_patches(x_ref, p_scr, base, masks, w: int, t: int, ci: int):
+    """Fill p_scr[9*Ci, T] from the padded image buffer: patch row
+    (tap*Ci + c), lane l  <-  B[c, base + (dy+1)*W + dx + 1 + l]."""
+    for tap, (dy, dx) in enumerate(TAPS):
+        off = base + (dy + 1) * w + dx + 1
+        sl = x_ref[0, :, pl.ds(off, t)]
+        m = masks[dx]
+        if m is not None:
+            sl = jnp.where(m, sl, jnp.zeros_like(sl))
+        p_scr[tap * ci:(tap + 1) * ci, :] = sl
+
+
+def _fwd_kernel(x_ref, w2_ref, y_ref, p_scr, *, w: int, t: int, ci: int,
+                groups: int):
+    base = 0 if groups == 1 else pl.program_id(1) * t
+    masks = _col_masks(w, t)
+    _build_patches(x_ref, p_scr, base, masks, w, t, ci)
+    y = jnp.dot(w2_ref[...], p_scr[...], preferred_element_type=jnp.float32)
+    y_ref[0, :, :] = y.astype(y_ref.dtype)
+
+
+def _wgrad_kernel(x_ref, dy_ref, dw2_ref, p_scr, acc_ref, *, w: int, t: int,
+                  ci: int, groups: int):
+    n = pl.program_id(0)
+    g = pl.program_id(1) if groups > 1 else 0
+    base = 0 if groups == 1 else pl.program_id(1) * t
+
+    @pl.when((n == 0) & (g == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    masks = _col_masks(w, t)
+    _build_patches(x_ref, p_scr, base, masks, w, t, ci)
+    dy = dy_ref[0, :, :]
+    # dW2[o, r] += sum_l dY[o, l] * P[r, l] — contraction over the lane dim
+    acc_ref[...] += jax.lax.dot_general(
+        dy, p_scr[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    last = (n == pl.num_programs(0) - 1) & (g == (groups - 1))
+
+    @pl.when(last)
+    def _emit():
+        dw2_ref[...] = acc_ref[...]
+
+
+def _conv_fwd(xf: jnp.ndarray, w2: jnp.ndarray, h: int, w: int):
+    """xf [N, Ci, H*W], w2 [Co, 9*Ci] -> [N, Co, H*W]."""
+    n, ci, hw = xf.shape
+    co = w2.shape[0]
+    t = _tile(hw)
+    groups = hw // t
+    xp = _pad_rows(xf, w)
+    kernel = partial(_fwd_kernel, w=w, t=t, ci=ci, groups=groups)
+    return pl.pallas_call(
+        kernel,
+        grid=(n, groups),
+        in_specs=[
+            pl.BlockSpec((1, ci, xp.shape[-1]), lambda i, g: (i, 0, 0)),
+            pl.BlockSpec((co, w2.shape[-1]), lambda i, g: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, co, t), lambda i, g: (i, 0, g)),
+        out_shape=_sds((n, co, hw), xf.dtype, xf),
+        scratch_shapes=[pltpu.VMEM((9 * ci, t), xf.dtype)],
+        interpret=_interpret(),
+    )(xp, w2)
+
+
+def _conv_wgrad(xf: jnp.ndarray, dyf: jnp.ndarray, h: int, w: int):
+    """xf [N, Ci, HW], dyf [N, Co, HW] -> dW2 [Co, 9*Ci] (f32)."""
+    n, ci, hw = xf.shape
+    co = dyf.shape[1]
+    t = _tile(hw)
+    groups = hw // t
+    xp = _pad_rows(xf, w)
+    kernel = partial(_wgrad_kernel, w=w, t=t, ci=ci, groups=groups)
+    return pl.pallas_call(
+        kernel,
+        grid=(n, groups),
+        in_specs=[
+            pl.BlockSpec((1, ci, xp.shape[-1]), lambda i, g: (i, 0, 0)),
+            pl.BlockSpec((1, co, t), lambda i, g: (i, 0, g)),
+        ],
+        out_specs=pl.BlockSpec((co, 9 * ci), lambda i, g: (0, 0)),
+        out_shape=_sds((co, 9 * ci), jnp.float32, xf),
+        scratch_shapes=[
+            pltpu.VMEM((9 * ci, t), xf.dtype),
+            pltpu.VMEM((co, 9 * ci), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(xp, dyf)
+
+
+def _xla_conv_nchw(xf, w, h, w_):
+    """Numerics reference / fallback: plain XLA conv on the lanes layout."""
+    n, ci, hw = xf.shape
+    x4 = xf.reshape(n, ci, h, w_)
+    y4 = jax.lax.conv_general_dilated(
+        x4, w, (1, 1), "SAME",
+        dimension_numbers=("NCHW", "HWIO", "NCHW"))
+    return y4.astype(xf.dtype).reshape(n, w.shape[-1], hw)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def conv3x3_lanes(xf: jnp.ndarray, w: jnp.ndarray, h: int, w_: int):
+    """SAME-padded stride-1 3x3 conv in lanes layout.
+
+    xf: [N, C_in, H*W]  (pixels in the trailing/lane dim)
+    w:  [3, 3, C_in, C_out]  (flax HWIO kernel)
+    returns [N, C_out, H*W].
+    """
+    return _conv_fwd(xf, _w2(w).astype(xf.dtype), h, w_)
+
+
+def _vjp_fwd(xf, w, h, w_):
+    y = _conv_fwd(xf, _w2(w).astype(xf.dtype), h, w_)
+    return y, (xf, w)
+
+
+def _vjp_bwd(h, w_, res, dyf):
+    xf, w = res
+    # dX: SAME conv of dY with the spatially-flipped, channel-transposed
+    # kernel (exact transpose of stride-1 SAME 3x3).
+    wt = jnp.flip(w, (0, 1)).transpose(0, 1, 3, 2)
+    dx = _conv_fwd(dyf, _w2(wt).astype(dyf.dtype), h, w_)
+    ci, co = w.shape[2], w.shape[3]
+    dw2 = _conv_wgrad(xf, dyf, h, w_)
+    dw = _w2_inv(dw2, ci, co).astype(w.dtype)
+    return dx, dw
+
+
+conv3x3_lanes.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def subsample2(xf: jnp.ndarray, h: int, w: int, offset: int = 0) -> jnp.ndarray:
+    """Stride-2 spatial subsample in lanes layout: [N,C,H*W] -> [N,C,HW/4].
+
+    ``offset=1`` (with the stride-1 3x3 kernel) reproduces XLA's SAME
+    stride-2 semantics for even H/W: SAME s2 pads (0,1), so its windows
+    are centered at 2i+1 — the ODD positions of the stride-1 output.
+    1x1 stride-2 convs keep offset=0 (their SAME windows sit at 2i)."""
+    assert h % 2 == 0 and w % 2 == 0, "stride-2 lanes path needs even H/W"
+    n, c, _ = xf.shape
+    return (xf.reshape(n, c, h, w)[:, :, offset::2, offset::2]
+            .reshape(n, c, (h // 2) * (w // 2)))
+
+
+def to_lanes(x_nhwc: jnp.ndarray) -> jnp.ndarray:
+    n, h, w, c = x_nhwc.shape
+    return x_nhwc.transpose(0, 3, 1, 2).reshape(n, c, h * w)
+
+
+def from_lanes(xf: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    n, c, _ = xf.shape
+    return xf.reshape(n, c, h, w).transpose(0, 2, 3, 1)
+
+
+# flax module: class is literally named Conv so flax auto-naming produces
+# the same 'Conv_k' parameter paths as nn.Conv — conv_impl='lanes' models
+# share their parameter pytree with the standard NHWC models bit-for-bit.
+class Conv(nn.Module):
+    """Drop-in for ``nn.Conv(features, (k,k), strides, 'SAME',
+    use_bias=False)`` operating in lanes layout [N, C, H*W].
+
+    kernel_size 3 runs the Pallas spatial-in-lanes kernel (stride 2 =
+    stride-1 kernel + subsample); kernel_size 1 is a plain einsum whose
+    GEMM already has pixels in lanes. Parameter name/shape match nn.Conv
+    ('kernel', [k,k,Ci,Co], f32)."""
+
+    features: int
+    hw: Tuple[int, int]
+    kernel_size: int = 3
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xf):
+        h, w_ = self.hw
+        ci = xf.shape[1]
+        k = self.kernel_size
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (k, k, ci, self.features), jnp.float32)
+        xf = xf.astype(self.dtype)
+        kd = kernel.astype(self.dtype)
+        if k == 1:
+            if self.strides == 2:
+                xf, h, w_ = subsample2(xf, h, w_), h // 2, w_ // 2
+            return jnp.einsum("io,nip->nop", kd[0, 0], xf)
+        if not supported(ci, h, w_):
+            y = _xla_conv_nchw(xf, kd, h, w_)
+        else:
+            y = conv3x3_lanes(xf, kd, h, w_)
+        if self.strides == 2:
+            y = subsample2(y, h, w_, offset=1)
+        return y

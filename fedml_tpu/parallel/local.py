@@ -29,6 +29,12 @@ from fedml_tpu.core.pytree import Pytree, tree_dot, tree_sub
 from fedml_tpu.core.tasks import Task
 from fedml_tpu.models import ModelBundle
 
+# Salt folded into each epoch key to derive the per-step batch keys. The
+# packed schedule (parallel/packed.py) replays each client's trajectory
+# bit-for-bit and must derive the SAME keys — it imports this constant, so
+# the two paths cannot silently desynchronize (advisor r4 #1).
+EPOCH_KEY_SALT = 0x5BA7
+
 
 def make_optimizer(
     name: str, lr: float, momentum: float = 0.0, wd: float = 0.0
@@ -188,7 +194,8 @@ def make_local_train_fn(
             xs = x_cast[order].reshape((steps, batch_size) + x.shape[1:])
             ys = y[order].reshape((steps, batch_size) + y.shape[1:])
             ms = mask[order].reshape((steps, batch_size))
-            bkeys = jax.random.split(jax.random.fold_in(ekey, 0x5ba7), steps)
+            bkeys = jax.random.split(
+                jax.random.fold_in(ekey, EPOCH_KEY_SALT), steps)
 
             def step_fn(carry, batch):
                 variables, opt_state = carry

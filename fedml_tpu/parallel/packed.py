@@ -32,9 +32,8 @@ import numpy as np
 
 from fedml_tpu.core.tasks import Task
 from fedml_tpu.models import ModelBundle
-from fedml_tpu.parallel.local import make_batch_sgd_step, make_optimizer
-
-_EPOCH_KEY_SALT = 0x5ba7   # must match make_local_train_fn's bkeys salt
+from fedml_tpu.parallel.local import (EPOCH_KEY_SALT as _EPOCH_KEY_SALT,
+                                      make_batch_sgd_step, make_optimizer)
 
 
 class PackPlan(NamedTuple):

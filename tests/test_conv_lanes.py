@@ -1,0 +1,140 @@
+"""Spatial-in-lanes Pallas conv (ops/conv_lanes.py) — exactness vs XLA.
+
+The kernel is a numerics drop-in for the flagship's stage-1/2 convs
+(docs/mfu_experiments.md H6): same math, different MXU lane mapping. On CPU
+backends pallas runs in interpret mode, so these tests pin semantics; the
+perf claim is measured on-chip by the whole-run bench A/B.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.models import create_model
+from fedml_tpu.ops.conv_lanes import (
+    _xla_conv_nchw, conv3x3_lanes, from_lanes, subsample2, to_lanes)
+
+
+def _rand(shape, seed=0, scale=1.0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape) * scale,
+                       jnp.float32)
+
+
+@pytest.mark.parametrize("ci,co,h,w", [(16, 16, 32, 32), (32, 32, 16, 16),
+                                       (16, 32, 32, 32), (32, 64, 16, 16)])
+def test_fwd_matches_xla(ci, co, h, w):
+    x = _rand((3, ci, h * w), seed=ci + co)
+    k = _rand((3, 3, ci, co), seed=1, scale=0.1)
+    got = conv3x3_lanes(x, k, h, w)
+    want = _xla_conv_nchw(x, k, h, w)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_grads_match_xla():
+    h = w = 32
+    x = _rand((2, 16, h * w))
+    k = _rand((3, 3, 16, 16), seed=1, scale=0.1)
+
+    def loss(fn):
+        return lambda x, k: jnp.sum(jnp.sin(fn(x, k, h, w)))
+
+    gx, gk = jax.grad(loss(conv3x3_lanes), (0, 1))(x, k)
+    rx, rk = jax.grad(loss(_xla_conv_nchw), (0, 1))(x, k)
+    np.testing.assert_allclose(gx, rx, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gk, rk, rtol=1e-4,
+                               atol=1e-4 * float(jnp.abs(rk).max()))
+
+
+def test_vmap_cohort_batching():
+    """The packed/sim schedules vmap the train step over cohort lanes with
+    per-lane weights — pallas batching must match the stacked loop."""
+    h = w = 16
+    xs = _rand((2, 3, 32, h * w))
+    ks = _rand((2, 3, 3, 32, 32), seed=2, scale=0.1)
+    got = jax.vmap(lambda a, b: conv3x3_lanes(a, b, h, w))(xs, ks)
+    want = jnp.stack([_xla_conv_nchw(xs[i], ks[i], h, w) for i in range(2)])
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_subsample_matches_same_stride2():
+    """stride-1 kernel + odd-offset subsample == XLA SAME stride-2 conv."""
+    h = w = 32
+    x = _rand((2, 16, h * w))
+    k = _rand((3, 3, 16, 32), seed=3, scale=0.1)
+    got = subsample2(conv3x3_lanes(x, k, h, w), h, w, offset=1)
+    x4 = x.reshape(2, 16, h, w)
+    want = jax.lax.conv_general_dilated(
+        x4, k, (2, 2), "SAME", dimension_numbers=("NCHW", "HWIO", "NCHW"))
+    np.testing.assert_allclose(
+        got, want.reshape(2, 32, (h // 2) * (w // 2)), rtol=2e-5, atol=2e-5)
+
+
+def test_layout_roundtrip():
+    x = _rand((2, 8, 4, 6)).transpose(0, 2, 3, 1)  # NHWC
+    assert jnp.array_equal(from_lanes(to_lanes(x), 4, 6), x)
+
+
+def test_resnet_lanes_param_tree_identical():
+    std = create_model("resnet20", 10)
+    lan = create_model("resnet20", 10, conv_impl="lanes")
+    v1 = std.init(jax.random.PRNGKey(0), batch_size=2)
+    v2 = lan.init(jax.random.PRNGKey(0), batch_size=2)
+    assert jtu.tree_structure(v1) == jtu.tree_structure(v2)
+    assert (jtu.tree_map(lambda a: a.shape, v1)
+            == jtu.tree_map(lambda a: a.shape, v2))
+
+
+def test_resnet_lanes_model_parity():
+    """Same params -> same logits / grads / batch stats (float-order
+    tolerance: the kernel sums taps in a different association, which
+    compounds through 20 layers)."""
+    std = create_model("resnet20", 10)
+    lan = create_model("resnet20", 10, conv_impl="lanes")
+    v = std.init(jax.random.PRNGKey(0), batch_size=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    labels = jnp.array([0, 1, 2, 3])
+
+    e1, e2 = std.apply_eval(v, x), lan.apply_eval(v, x)
+    np.testing.assert_allclose(e1, e2, rtol=0, atol=5e-3)
+
+    def loss(bundle, p):
+        logits, newv = bundle.apply_train(
+            {**v, "params": p}, x, jax.random.PRNGKey(0))
+        return (optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean(), newv)
+
+    (l1, nv1), g1 = jax.value_and_grad(
+        lambda p: loss(std, p), has_aux=True)(v["params"])
+    (l2, nv2), g2 = jax.value_and_grad(
+        lambda p: loss(lan, p), has_aux=True)(v["params"])
+    assert abs(float(l1 - l2)) < 5e-3
+    for a, b in zip(jtu.tree_leaves(g1), jtu.tree_leaves(g2)):
+        np.testing.assert_allclose(
+            a, b, rtol=0, atol=5e-2 * max(1e-3, float(jnp.abs(a).max())))
+    for a, b in zip(jtu.tree_leaves(nv1["batch_stats"]),
+                    jtu.tree_leaves(nv2["batch_stats"])):
+        np.testing.assert_allclose(a, b, rtol=0, atol=5e-3)
+
+
+def test_lanes_rides_fedavg_round():
+    """The lanes model must run through the packed federated round program
+    (vmap over lanes + lax.scan over steps) unchanged."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+
+    ds = make_synthetic_classification(
+        "lanes-round", (32, 32, 3), 10, 4, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=0)
+    cfg = FedConfig(model="resnet20", dataset="cifar10",
+                    client_num_in_total=4, client_num_per_round=2,
+                    comm_round=1, batch_size=4, epochs=1, lr=0.1,
+                    momentum=0.9, seed=0, pack_lanes=2,
+                    frequency_of_the_test=10_000)
+    bundle = create_model("resnet20", 10, conv_impl="lanes")
+    api = FedAvgAPI(ds, cfg, bundle)
+    loss = api.run_round(1)
+    assert np.isfinite(float(loss))
