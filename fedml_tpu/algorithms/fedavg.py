@@ -144,6 +144,15 @@ class FedAvgAPI:
         train_x (bf16-cast when training in bf16) or None when ineligible."""
         c = self.config
         ds = self.dataset
+        if getattr(ds, "virtual", False):
+            # cross-device scale: the client stack does not exist; rounds
+            # materialize O(cohort) slices host-side (data/crossdevice.py)
+            if c.device_data == "on":
+                log.warning(
+                    "device_data='on' ignored: %s is a virtual cross-device "
+                    "dataset (%d clients); using the sampled host-slice path",
+                    ds.name, ds.num_clients)
+            return None
         x = ds.train_x
         cast_bf16 = c.dtype == "bfloat16" and np.issubdtype(x.dtype, np.floating)
         nbytes = ((x.size * 2 if cast_bf16 else x.nbytes) + ds.train_y.nbytes
@@ -531,15 +540,12 @@ class FedAvgAPI:
                 and self._packing_supported()):
             pk = self._packed_plan(sampled)
             if pk is not None:
-                # Per-epoch slots straight from the plan (advisor r4 #3):
-                # each epoch executes every member's real steps once; the
-                # dead lane-tail slots (T*lanes - epochs*real) run once per
-                # ROUND and are amortized over epochs — exact at epochs=1
-                # (the bench recipe), off by < epochs slots otherwise.
+                # packed lanes execute T batch-steps each over the whole
+                # round; report one epoch's share, rounded to nearest
+                # (exact at epochs=1, the bench recipe; off by <1 batch
+                # otherwise — advisor r4 #3)
                 ep = max(self.config.epochs, 1)
-                real_steps = int((pk.steps_real * pk.member_valid).sum())
-                tail = pk.n_lanes * pk.T - ep * real_steps
-                padded = (real_steps + round(tail / ep)) * self.config.batch_size
+                padded = round(pk.executed_slots / ep) * self.config.batch_size
                 return int(counts.sum()), int(padded)
         plan = self._round_groups(sampled, live) if self._dev_train is not None else None
         if plan is not None:

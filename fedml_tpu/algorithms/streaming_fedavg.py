@@ -57,11 +57,6 @@ class StreamingFedAvgAPI(FedAvgAPI):
         self._batch_step = self._build_batch_step()
         self._opt_init = jax.jit(lambda p: self._opt_tx.init(p))
         self._finish_jit = jax.jit(self._finish_round)
-        # host-resident client slices (numpy views, never device_put whole)
-        ds = self.dataset
-        self._host_x = np.asarray(ds.train_x)
-        self._host_y = np.asarray(ds.train_y)
-        self._host_mask = np.asarray(ds.train_mask)
 
     def build_round_step(self):
         # rounds are driven batch-by-batch in run_round; there is no single
@@ -109,7 +104,11 @@ class StreamingFedAvgAPI(FedAvgAPI):
 
         c = self.config
         bs = c.batch_size
-        x, y, mask = self._host_x[k], self._host_y[k], self._host_mask[k]
+        # one client's host arrays: a view for stacked datasets, an
+        # O(1-client) materialization for virtual cross-device ones
+        x, y, mask = self.dataset.client_arrays(int(k))
+        x, y = np.asarray(x), np.asarray(y)
+        mask = np.asarray(mask)
         count = float(self.dataset.train_counts[k])
         orders, ekeys, steps_real = self._client_orders(mask, count, rng)
         n_pad = mask.shape[0]

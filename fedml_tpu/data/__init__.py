@@ -53,6 +53,11 @@ class FedDataset:
     test_mask_local: Optional[np.ndarray] = None
     name: str = ""
 
+    #: True for cross-device datasets whose client stack is never
+    #: materialized (data/crossdevice.py) — algorithms must use
+    #: client_slice/client_arrays and keep memory O(cohort)
+    virtual = False
+
     @property
     def num_clients(self) -> int:
         return int(self.train_x.shape[0])
@@ -75,13 +80,18 @@ class FedDataset:
             self.train_counts[idx],
         )
 
+    def client_arrays(self, k: int):
+        """One client's (x, y, mask) — the streaming paradigm's accessor
+        (virtual datasets materialize it on demand)."""
+        return self.train_x[k], self.train_y[k], self.train_mask[k]
+
 
 def load_dataset(name: str, **kw) -> FedDataset:
     """Dispatch on the reference's --dataset flag values (mnist, femnist,
     shakespeare, fed_shakespeare, fed_cifar100, stackoverflow_lr,
     stackoverflow_nwp, cifar10, cifar100, cinic10, synthetic_1_1, ...)."""
     from fedml_tpu.data import (  # noqa: F401
-        cifar, femnist, imagenet, mnist, segmentation, shakespeare, stackoverflow, synthetic,
+        cifar, crossdevice, femnist, imagenet, mnist, segmentation, shakespeare, stackoverflow, synthetic,
     )
     if name not in _LOADERS:
         raise KeyError(f"unknown dataset {name!r}; known: {sorted(_LOADERS)}")
@@ -90,6 +100,6 @@ def load_dataset(name: str, **kw) -> FedDataset:
 
 def known_datasets() -> list[str]:
     from fedml_tpu.data import (  # noqa: F401
-        cifar, femnist, imagenet, mnist, segmentation, shakespeare, stackoverflow, synthetic,
+        cifar, crossdevice, femnist, imagenet, mnist, segmentation, shakespeare, stackoverflow, synthetic,
     )
     return sorted(_LOADERS)

@@ -190,6 +190,15 @@ def load_stackoverflow_lr(
 ) -> FedDataset:
     h5 = os.path.join(data_dir, "stackoverflow_train.h5")
     if not os.path.exists(h5):
+        if client_num_in_total > 4096:
+            # the reference's real operating point (342,477 clients): the
+            # stacked fallback cannot hold that, so serve the cross-device
+            # sampled-materialization dataset at the full client count
+            from fedml_tpu.data.crossdevice import load_stackoverflow_lr_full
+
+            return load_stackoverflow_lr_full(
+                client_num_in_total=client_num_in_total,
+                batch_size=batch_size, seed=seed)
         return _synthetic_so_lr(min(client_num_in_total, 100), batch_size, seed)
     missing = [f for f in (WORD_COUNT_FILE, TAG_COUNT_FILE)
                if not os.path.exists(os.path.join(data_dir, f))]

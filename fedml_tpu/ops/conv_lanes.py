@@ -64,13 +64,13 @@ from fedml_tpu.ops.common import sds as _sds
 def supported(c_in: int, h: int, w: int) -> bool:
     """Shapes the kernel handles; callers fall back to XLA otherwise.
     C_in must respect sublane granularity (patch rows sit at offsets
-    t*C_in), and the derived lane tile must be a multiple of W — the
-    static edge masks assume every tile starts at an image-row boundary
-    (lane l's x-coord is l % W only then)."""
+    t*C_in). Images must fit one lane tile (hw <= MAX_TILE): the
+    multi-tile path would need dynamic lane offsets of program_id(1)*t
+    plus non-128-aligned tap shifts, which Mosaic rejects ("cannot
+    statically prove index is a multiple of 128") — single-tile keeps
+    every tap offset static."""
     hw = h * w
-    if c_in % 8 or hw % 128 or (hw > MAX_TILE and hw % MAX_TILE):
-        return False
-    return _tile(hw) % w == 0
+    return c_in % 8 == 0 and hw % 128 == 0 and hw <= MAX_TILE
 
 
 def _tile(hw: int) -> int:

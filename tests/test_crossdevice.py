@@ -1,0 +1,126 @@
+"""Cross-device scale (data/crossdevice.py) — the reference's 342,477-client
+operating point (stackoverflow benchmark row), VERDICT r4 #2.
+
+Pins: (1) a 100,000+-client dataset costs O(num_clients) metadata and
+O(cohort) per-round materialization — never the full stack; (2) sampling,
+pack planning, federated rounds, and the streaming paradigm all run at that
+scale; (3) virtual datasets refuse silent densification."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.core.rng import sample_clients
+from fedml_tpu.data import load_dataset
+from fedml_tpu.data.crossdevice import (CrossDeviceDataset, VirtualArray,
+                                        make_synthetic_crossdevice)
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel.packed import plan_packing
+
+N_CLIENTS = 100_000
+COHORT = 50
+
+
+@pytest.fixture(scope="module")
+def ds():
+    # small feature dim keeps the CPU test quick; the client COUNT is the
+    # thing under test (the bench row runs the full 10k-dim shape)
+    return make_synthetic_crossdevice(
+        "xdev-test", 32, 10, N_CLIENTS, batch_size=10, mean_records=12.0,
+        max_records=40, seed=3)
+
+
+def test_metadata_is_o_num_clients(ds):
+    assert ds.num_clients == N_CLIENTS
+    assert isinstance(ds.train_x, VirtualArray)
+    # the only O(num_clients) array is the counts vector
+    assert ds.train_counts.shape == (N_CLIENTS,)
+    assert ds.train_counts.nbytes < 1_000_000
+    # the virtual stack ADVERTISES its true (huge) size so the device-
+    # residency eligibility check declines it
+    assert ds.train_x.nbytes > 4 * 10**8
+
+
+def test_virtual_stack_refuses_densification(ds):
+    with pytest.raises(RuntimeError, match="cross-device"):
+        ds.train_x[0]
+    with pytest.raises(RuntimeError, match="cross-device"):
+        np.asarray(ds.train_x)
+
+
+def test_sampling_and_packing_at_scale(ds):
+    sampled = sample_clients(7, N_CLIENTS, COHORT, seed=0)
+    assert len(np.unique(sampled)) == COHORT
+    assert sampled.max() < N_CLIENTS
+    # different rounds sample different cohorts
+    assert not np.array_equal(sampled, sample_clients(8, N_CLIENTS, COHORT, 0))
+    # the pack planner works from counts alone — O(cohort log cohort)
+    plan = plan_packing(ds.train_counts[sampled], batch_size=10, epochs=1,
+                        n_lanes=4)
+    assert plan is not None
+    covered = (plan.steps_real * plan.member_valid).sum()
+    want = np.ceil(ds.train_counts[sampled] / 10).sum()
+    assert covered == want
+
+
+def test_cohort_materialization_is_deterministic(ds):
+    idx = np.array([5, 99_999, 42_000])
+    x1, y1, m1, c1 = ds.client_slice(idx)
+    x2, y2, m2, c2 = ds.client_slice(idx)
+    assert np.array_equal(x1, x2) and np.array_equal(y1, y2)
+    assert x1.shape[0] == 3 and m1.shape == x1.shape[:2]
+    # per-client accessor agrees with the cohort slice
+    xa, ya, ma = ds.client_arrays(42_000)
+    assert np.array_equal(xa, x1[2]) and np.array_equal(ma, m1[2])
+
+
+def test_fedavg_rounds_at_100k_with_o_cohort_memory(ds):
+    rounds = 2
+    cfg = FedConfig(
+        model="lr", dataset="xdev", client_num_in_total=N_CLIENTS,
+        client_num_per_round=COHORT, comm_round=rounds, batch_size=10,
+        epochs=1, lr=0.1, seed=0, frequency_of_the_test=10_000,
+        device_data="on",  # must be IGNORED for virtual datasets
+    )
+    bundle = create_model("lr", ds.class_num, input_shape=(32,))
+    ds.materialized_rows = 0
+    api = FedAvgAPI(ds, cfg, bundle)
+    assert api._dev_train is None  # virtual stack never went device-resident
+    losses = [float(api.run_round(r)) for r in range(1, rounds + 1)]
+    assert all(np.isfinite(losses))
+    # memory-bound evidence: exactly rounds x cohort x n_pad padded rows
+    # were ever materialized (+ nothing proportional to N_CLIENTS)
+    n_pad = ds.train_x.shape[1]
+    assert ds.materialized_rows == rounds * COHORT * n_pad
+
+
+def test_streaming_paradigm_at_scale(ds):
+    from fedml_tpu.algorithms.streaming_fedavg import StreamingFedAvgAPI
+
+    cfg = FedConfig(
+        model="lr", dataset="xdev", client_num_in_total=N_CLIENTS,
+        client_num_per_round=4, comm_round=1, batch_size=10, epochs=1,
+        lr=0.1, seed=0, frequency_of_the_test=10_000)
+    bundle = create_model("lr", ds.class_num, input_shape=(32,))
+    ds.materialized_rows = 0
+    api = StreamingFedAvgAPI(ds, cfg, bundle)
+    loss = float(api.run_round(1))
+    assert np.isfinite(loss)
+    n_pad = ds.train_x.shape[1]
+    assert ds.materialized_rows == 4 * n_pad
+
+
+def test_stackoverflow_full_loader_registered():
+    ds = load_dataset("stackoverflow_lr_full", client_num_in_total=342_477,
+                      batch_size=10)
+    assert isinstance(ds, CrossDeviceDataset)
+    assert ds.num_clients == 342_477
+    assert ds.train_x.shape == (342_477, 70, 10_000)
+    assert ds.task == "tag_prediction"
+    x, y, m, c = ds.client_slice(np.array([0, 342_476]))
+    assert x.shape == (2, 70, 10_000) and y.shape == (2, 70, 500)
+    # the stackoverflow_lr name routes to the same path at big counts
+    ds2 = load_dataset("stackoverflow_lr", client_num_in_total=342_477,
+                       batch_size=10)
+    assert isinstance(ds2, CrossDeviceDataset)
