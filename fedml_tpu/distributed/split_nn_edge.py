@@ -37,6 +37,10 @@ MSG_TYPE_C2S_VALIDATION_MODE = 3
 MSG_TYPE_C2S_VALIDATION_OVER = 4
 MSG_TYPE_C2S_PROTOCOL_FINISHED = 5
 MSG_TYPE_C2C_SEMAPHORE = 6
+# managed-ring (fault-tolerant) mode additions — no reference counterpart:
+# the reference's ring stalls forever on a dead client
+MSG_TYPE_C2S_TURN_DONE = 7
+MSG_TYPE_S2C_FINISHED = 8
 
 MSG_ARG_KEY_ACTS = "activations"
 MSG_ARG_KEY_LABELS = "labels"
@@ -196,17 +200,131 @@ class SplitNNServerTrainer:
 
 
 class SplitNNEdgeServerManager(ServerManager):
-    def __init__(self, args, comm, rank, size, trainer: SplitNNServerTrainer):
+    """Strict mode: passive compute peer (the reference's shape). Managed
+    mode (``deadline`` set): the server OWNS the relay ring — clients
+    report TURN_DONE instead of passing the semaphore peer-to-peer, and a
+    client that stops producing activations within the deadline is marked
+    dead and the ring re-forms around it (the r4 verdict's SplitNN item)."""
+
+    def __init__(self, args, comm, rank, size, trainer: SplitNNServerTrainer,
+                 deadline: float | None = None):
         super().__init__(args, comm, rank, size)
         self.trainer = trainer
+        self.deadline = deadline
+        self._alive = {r: True for r in range(1, size)}
+        trainer.ring_alive = self._alive  # surfaced on the returned trainer
+        self._ring = list(range(1, size))
+        self._pos = -1
+        self._activity = 0
+        self._timer = None
+        if deadline is not None:
+            from fedml_tpu.distributed.base_framework import (
+                RoundDeadlineTimer, require_injectable)
+
+            require_injectable(comm)
+            self._timer = RoundDeadlineTimer(comm, float(deadline),
+                                             rank, "pos")
+
+    def run(self):
+        self.register_message_receive_handlers()
+        if self.deadline is not None:
+            self._advance()   # kick the first live client
+        self.com_manager.handle_receive_message()
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_TYPE_C2S_SEND_ACTS, self.handle_message_acts)
-        self.register_message_receive_handler(MSG_TYPE_C2S_VALIDATION_MODE, lambda m: self.trainer.eval_mode())
-        self.register_message_receive_handler(MSG_TYPE_C2S_VALIDATION_OVER, lambda m: self.trainer.validation_over())
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_VALIDATION_MODE,
+            lambda m: None if self._zombie(m) else self.trainer.eval_mode())
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_VALIDATION_OVER,
+            lambda m: None if self._zombie(m) else self.trainer.validation_over())
         self.register_message_receive_handler(MSG_TYPE_C2S_PROTOCOL_FINISHED, self.handle_finish)
+        if self.deadline is not None:
+            from fedml_tpu.distributed.base_framework import (
+                MSG_TYPE_LOCAL_ROUND_DEADLINE)
 
+            self.register_message_receive_handler(MSG_TYPE_C2S_TURN_DONE,
+                                                  self._on_turn_done)
+            self.register_message_receive_handler(
+                MSG_TYPE_LOCAL_ROUND_DEADLINE, self._on_deadline)
+
+    # -- managed ring ------------------------------------------------------
+    def _zombie(self, msg: Message) -> bool:
+        """Managed mode: True for protocol messages from any rank other
+        than the CURRENT live turn-holder — a skipped-then-woken client
+        must not flip the shared trainer phase or feed batches into the
+        healthy client's turn (review r5 #2)."""
+        if self.deadline is None:
+            return False
+        s_ = msg.get_sender_id()
+        return (self._pos >= len(self._ring)
+                or self._ring[self._pos] != s_
+                or not self._alive.get(s_, False))
+
+    def _advance(self):
+        """Hand the turn to the next live client, or finish the ring."""
+        while True:
+            self._pos += 1
+            if self._pos >= len(self._ring):
+                self._finish_all()
+                return
+            nxt = self._ring[self._pos]
+            if not self._alive[nxt]:
+                continue
+            self._activity = 0
+            try:
+                self.send_message(
+                    Message(MSG_TYPE_C2C_SEMAPHORE, self.rank, nxt))
+            except Exception as e:
+                log.warning("splitnn ring: kick of rank %d failed (%s)",
+                            nxt, e)
+                self._alive[nxt] = False
+                continue
+            self._timer.arm(self._pos)
+            return
+
+    def _on_turn_done(self, msg: Message):
+        if self._zombie(msg):
+            return  # late report from an already-skipped client
+        self._timer.cancel()
+        self._advance()
+
+    def _on_deadline(self, msg: Message):
+        if int(msg.get("pos")) != self._pos:
+            return  # stale timer
+        if self._activity > 0:
+            # slow but alive: keep waiting another window
+            self._activity = 0
+            self._timer.arm(self._pos)
+            return
+        dead = self._ring[self._pos]
+        log.warning("splitnn ring: rank %d silent past the %.1fs deadline — "
+                    "skipping it and re-forming the ring", dead, self.deadline)
+        self._alive[dead] = False
+        # drop a half-finished validation phase cleanly
+        self.trainer.train_mode()
+        self._advance()
+
+    def _finish_all(self):
+        if self._timer is not None:
+            self._timer.cancel()
+        # FINISHED goes to every rank, dead-marked included: in-process
+        # "dead" clients are live threads that must still exit
+        for r in range(1, self.size):
+            try:
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISHED, self.rank, r))
+            except Exception:
+                pass
+        self.finish()
+
+    # -- compute peer ------------------------------------------------------
     def handle_message_acts(self, msg: Message):
+        if self._zombie(msg):
+            return  # late batch from a skipped client: no grads back — it
+            #         parks in handle_gradients instead of corrupting state
+        self._activity += 1
         acts = msg.get(MSG_ARG_KEY_ACTS)
         labels = msg.get(MSG_ARG_KEY_LABELS)
         mask = msg.get(MSG_ARG_KEY_MASK)
@@ -216,7 +334,19 @@ class SplitNNEdgeServerManager(ServerManager):
         if self.trainer.phase == "train":
             out = Message(MSG_TYPE_S2C_GRADS, self.rank, msg.get_sender_id())
             out.add_params(MSG_ARG_KEY_GRADS, grads)
-            self.send_message(out)
+            try:
+                self.send_message(out)
+            except Exception as e:
+                if self.deadline is None:
+                    raise
+                dead = msg.get_sender_id()
+                log.warning("splitnn ring: grads to rank %d failed (%s)",
+                            dead, e)
+                self._alive[dead] = False
+                if self._ring[self._pos] == dead:
+                    self._timer.cancel()
+                    self.trainer.train_mode()
+                    self._advance()
 
     def handle_finish(self, msg: Message):
         self.finish()
@@ -226,7 +356,7 @@ class SplitNNEdgeClientManager(ClientManager):
     """Reference client_manager.py:8-87 — relay ring with per-batch exchange."""
 
     def __init__(self, args, comm, rank, size, trainer: SplitNNClientTrainer,
-                 epochs_per_turn: int, turns: int):
+                 epochs_per_turn: int, turns: int, managed: bool = False):
         super().__init__(args, comm, rank, size)
         self.trainer = trainer
         self.epochs_per_turn = epochs_per_turn  # MAX_EPOCH_PER_NODE
@@ -236,16 +366,23 @@ class SplitNNEdgeClientManager(ClientManager):
         self.MAX_RANK = size - 1
         self.node_right = 1 if rank == self.MAX_RANK else rank + 1
         self.SERVER_RANK = 0
+        #: managed mode: the SERVER owns the ring — wait for its semaphore,
+        #: report TURN_DONE instead of passing peer-to-peer, finish on its
+        #: FINISHED broadcast (fault-tolerant ring re-forming)
+        self.managed = managed
 
     def run(self):
         self.register_message_receive_handlers()
-        if self.rank == 1:
+        if self.rank == 1 and not self.managed:
             self.run_forward_pass()
         self.com_manager.handle_receive_message()
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_TYPE_C2C_SEMAPHORE, self.handle_semaphore)
         self.register_message_receive_handler(MSG_TYPE_S2C_GRADS, self.handle_gradients)
+        if self.managed:
+            self.register_message_receive_handler(
+                MSG_TYPE_S2C_FINISHED, lambda m: self.finish())
 
     def handle_semaphore(self, msg: Message):
         self.trainer.train_mode()
@@ -277,6 +414,12 @@ class SplitNNEdgeClientManager(ClientManager):
         if self.epoch_in_turn >= self.epochs_per_turn:
             self.epoch_in_turn = 0
             self.turn_idx += 1
+            if self.managed:
+                # hand the turn back to the ring owner and await the next
+                # semaphore or the FINISHED broadcast
+                self.send_message(Message(MSG_TYPE_C2S_TURN_DONE, self.rank,
+                                          self.SERVER_RANK))
+                return
             if self.turn_idx >= self.turns:
                 if self.rank == self.MAX_RANK:
                     # last client of the last turn ends the whole protocol
@@ -297,11 +440,16 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
     transport (or a real one — e.g. gRPC loopback — via ``comm_factory``).
     Each client takes ``config.epochs`` epochs per turn and the ring runs
     one full cycle (turns=1), mirroring the reference defaults. Returns the
-    server trainer (val_history, final variables)."""
-    from fedml_tpu.distributed.base_framework import warn_strict_barrier
+    server trainer (val_history, final variables).
 
-    warn_strict_barrier(config, __name__)
+    With ``config.straggler_deadline_sec`` set the ring is server-managed:
+    a client that stops producing activations within the deadline is marked
+    dead, the ring re-forms around it, and the remaining clients' turns
+    still run (its data is simply unseen — the same drop semantics as
+    fedavg_edge's partial aggregation)."""
     from fedml_tpu.core.rng import seed_everything
+
+    deadline = getattr(config, "straggler_deadline_sec", None)
 
     task = get_task(dataset.task, dataset.class_num)
     n_clients = dataset.num_clients
@@ -324,7 +472,8 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
 
     def make(rank, comm):
         if rank == 0:
-            return SplitNNEdgeServerManager(Args(), comm, rank, size, server_trainer)
+            return SplitNNEdgeServerManager(Args(), comm, rank, size,
+                                            server_trainer, deadline=deadline)
         k = rank - 1
         x, y, m, count = dataset.client_slice(np.asarray([k]))
         n_real = int(count[0])
@@ -339,7 +488,8 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
         )
         trainer.init(client_bundle.init(keys[k]))
         return SplitNNEdgeClientManager(Args(), comm, rank, size, trainer,
-                                        epochs_per_turn=config.epochs, turns=1)
+                                        epochs_per_turn=config.epochs, turns=1,
+                                        managed=deadline is not None)
 
     run_ranks(make, size, wire_roundtrip=wire_roundtrip,
               comm_factory=comm_factory)

@@ -185,12 +185,27 @@ class VFLGuestManager(ServerManager):
 
 def run_vfl_edge(dataset, hidden_dim: int = 16, lr: float = 0.01,
                  batch_size: int = 64, epochs: int = 10, seed: int = 0,
-                 wire_roundtrip: bool = True, comm_factory=None):
+                 wire_roundtrip: bool = True, comm_factory=None,
+                 straggler_deadline_sec=None):
     """Launch guest (rank 0) + one host per remaining party over the local
     transport (or gRPC via ``comm_factory``). Same init derivation as
     build_protocol_vfl(seed) and same batch schedule as VFLAPI.fit(epochs,
     seed). Returns the guest manager (parties hold final params;
-    ``history[-1]`` the final metrics)."""
+    ``history[-1]`` the final metrics).
+
+    VFL is the ONE edge protocol that genuinely cannot drop a participant:
+    each party owns a disjoint FEATURE slice, so the forward pass needs
+    every party's embedding — losing one changes the model's input
+    dimensionality mid-training (there is no 'train on fewer features'
+    fallback that preserves the learned feature interactions). The strict
+    barrier stays; ``straggler_deadline_sec`` is warned about and ignored
+    (docs/deploy.md 'Fault tolerance')."""
+    import types
+
+    from fedml_tpu.distributed.base_framework import warn_strict_barrier
+
+    warn_strict_barrier(types.SimpleNamespace(
+        straggler_deadline_sec=straggler_deadline_sec), __name__)
     root = jax.random.PRNGKey(seed)
     keys = jax.random.split(root, dataset.num_parties)
     guest = VFLGuestParty(
