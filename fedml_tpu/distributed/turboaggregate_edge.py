@@ -60,6 +60,25 @@ KEY_LOSS_SUM = "loss_sum"    # non-secret metric riding the relay
 KEY_COUNT_SUM = "count_sum"
 
 
+def _unflatten_template(variables):
+    """(treedef, shapes, dtypes) for field-vector <-> pytree mapping —
+    shared by both server managers so the two protocol paths cannot
+    drift."""
+    import jax as _jax
+
+    leaves, treedef = _jax.tree.flatten(_jax.tree.map(np.asarray, variables))
+    return treedef, [l.shape for l in leaves], [l.dtype for l in leaves]
+
+
+def _unflatten_flat(flat, treedef, shapes, dtypes):
+    out, off = [], 0
+    for shape, dtype in zip(shapes, dtypes):
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        out.append(flat[off:off + n].reshape(shape).astype(dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
 def _groups(num_clients: int, group_size: int) -> list[list[int]]:
     """Round-robin grouping, identical to secure_weighted_sum's
     ``range(g, C, n_groups)`` (algorithms/turboaggregate.py:232)."""
@@ -84,9 +103,7 @@ class TAEdgeServerManager(ServerManager):
                                          "Test/Loss": [], "Train/Loss": []}
         self._eval = make_eval_fn(bundle, get_task(dataset.task, dataset.class_num))
         # flatten template: leaf order/shape/dtype for field <-> pytree
-        leaves, self._treedef = jax.tree.flatten(jax.tree.map(np.asarray, variables))
-        self._shapes = [l.shape for l in leaves]
-        self._dtypes = [l.dtype for l in leaves]
+        self._treedef, self._shapes, self._dtypes = _unflatten_template(variables)
         counts = np.asarray(dataset.train_counts, np.float64)[: size - 1]
         self._weights = counts / counts.sum()
 
@@ -115,12 +132,8 @@ class TAEdgeServerManager(ServerManager):
                 f"at server in round {self.round_idx}")
         field_total = np.asarray(msg.get(KEY_FIELD), np.int64)
         flat = dequantize(field_total, self.frac_bits, self.p)
-        out, off = [], 0
-        for shape, dtype in zip(self._shapes, self._dtypes):
-            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            out.append(flat[off:off + n].reshape(shape).astype(dtype))
-            off += n
-        self.variables = jax.tree.unflatten(self._treedef, out)
+        self.variables = _unflatten_flat(flat, self._treedef, self._shapes,
+                                         self._dtypes)
         train_loss = float(msg.get(KEY_LOSS_SUM)) / max(float(msg.get(KEY_COUNT_SUM)), 1e-12)
         if (self.round_idx % self.args.frequency_of_the_test == 0
                 or self.round_idx == self.round_num - 1):
@@ -358,10 +371,7 @@ class TAThresholdServerManager(ServerManager):
                                          "Test/Loss": [], "Train/Loss": []}
         self._eval_fn = make_eval_fn(bundle,
                                      get_task(dataset.task, dataset.class_num))
-        leaves, self._treedef = jax.tree.flatten(
-            jax.tree.map(np.asarray, variables))
-        self._shapes = [l.shape for l in leaves]
-        self._dtypes = [l.dtype for l in leaves]
+        self._treedef, self._shapes, self._dtypes = _unflatten_template(variables)
         counts = np.asarray(dataset.train_counts,
                             np.float64)[: self.num_clients]
         self._weights = counts / counts.sum()
@@ -436,6 +446,7 @@ class TAThresholdServerManager(ServerManager):
 
     def _start_reveal(self):
         self._timer.cancel()
+        self._empty = 0   # progress: the budget counts CONSECUTIVE stalls
         self._dealers = sorted(self._dealt)
         self._phase = "eval"
         for cid in self._live():
@@ -506,6 +517,7 @@ class TAThresholdServerManager(ServerManager):
 
     def _finish_round(self):
         self._timer.cancel()
+        self._empty = 0
         ids = sorted(self._evals)
         shares = np.stack([self._evals[i] for i in ids])
         from fedml_tpu.algorithms.turboaggregate import bgw_decode
@@ -513,12 +525,8 @@ class TAThresholdServerManager(ServerManager):
         field_sum = bgw_decode(shares, ids, self.p)
         w_d = float(sum(self._weights[d] for d in self._dealers))
         flat = dequantize(field_sum, self.frac_bits, self.p) / max(w_d, 1e-12)
-        out, off = [], 0
-        for shape, dtype in zip(self._shapes, self._dtypes):
-            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            out.append(flat[off:off + n].reshape(shape).astype(dtype))
-            off += n
-        self.variables = jax.tree.unflatten(self._treedef, out)
+        self.variables = _unflatten_flat(flat, self._treedef, self._shapes,
+                                         self._dtypes)
         loss_sum = sum(l for _c, l in self._dealt.values())
         count_sum = sum(c for c, _l in self._dealt.values())
         train_loss = loss_sum / max(count_sum, 1e-12)
@@ -634,9 +642,12 @@ class TAThresholdClientManager(ClientManager):
         done.add_params(KEY_COUNT, float(count[0]))
         done.add_params(KEY_LOSS, float(res.train_loss) * float(count[0]))
         self.send_message(done)
-        for handler, pending in self._ahead:
-            handler(pending)
-        self._ahead.clear()
+        # snapshot-and-swap: replayed handlers may legitimately RE-buffer
+        # messages that are still ahead (a gen+2 share during the gen+1
+        # replay) — iterating the live list would chase its own appends
+        pending, self._ahead = self._ahead, []
+        for handler, msg_p in pending:
+            handler(msg_p)
 
     def _on_tshare(self, msg: Message):
         if self._ahead_of_round(msg, self._on_tshare):

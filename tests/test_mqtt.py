@@ -158,6 +158,114 @@ def test_manager_runtime_over_mqtt(mqtt_env):
     assert sorted(managers[0].got) == [(1, 10), (2, 20)]
 
 
+class TestRealTCPBroker:
+    """The same backend over REAL sockets: the in-repo MQTT 3.1.1 broker
+    (comm/mqtt_broker.py) + the socket client (comm/mqtt_client.py) that
+    serves when paho is absent (VERDICT r4 #4). Wire framing, partial
+    reads, concurrent publishers, and reconnect all actually happen."""
+
+    def test_roundtrip_over_tcp(self):
+        import fedml_tpu.comm.mqtt_broker as mb
+
+        with mb.MqttBroker(0) as broker:
+            server = mqtt_backend.MqttCommManager(
+                "127.0.0.1", broker.port, client_id=0, client_num=2)
+            c1 = mqtt_backend.MqttCommManager(
+                "127.0.0.1", broker.port, client_id=1, client_num=2)
+            import time
+            time.sleep(0.3)  # CONNACK->subscribe happens on the reader thread
+            up = Message("up", 1, 0)
+            up.add_params(MSG_ARG_KEY_MODEL_PARAMS,
+                          {"w": np.arange(6, dtype=np.float32).reshape(2, 3)})
+            c1.send_message(up)
+            got = server._inbox.get(timeout=5)
+            assert got.get_type() == "up" and got.get_sender_id() == 1
+            np.testing.assert_array_equal(
+                got.get(MSG_ARG_KEY_MODEL_PARAMS)["w"],
+                np.arange(6, dtype=np.float32).reshape(2, 3))
+            down = Message("down", 0, 1)
+            down.add_params("x", 7)
+            server.send_message(down)
+            assert c1._inbox.get(timeout=5).get("x") == 7
+            for m in (server, c1):
+                m.stop_receive_message()
+
+    def test_federation_over_tcp_broker(self):
+        """A full FedAvg edge federation (init/sync/upload/finish, binary
+        model payloads) where every message rides the TCP broker."""
+        import fedml_tpu.comm.mqtt_broker as mb
+        from fedml_tpu.core.config import FedConfig
+        from fedml_tpu.data.synthetic import make_synthetic_classification
+        from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+
+        ds = make_synthetic_classification(
+            "mqtt-fed", (8,), 3, 2, records_per_client=8,
+            partition_method="homo", batch_size=4, seed=1)
+        cfg = FedConfig(model="lr", dataset="synthetic",
+                        client_num_in_total=2, client_num_per_round=2,
+                        comm_round=2, epochs=1, batch_size=4, lr=0.1,
+                        seed=0, frequency_of_the_test=1, device_data="off")
+        with mb.MqttBroker(0) as broker:
+            agg = run_fedavg_edge(
+                ds, cfg, worker_num=2,
+                comm_factory=lambda r: mqtt_backend.MqttCommManager(
+                    "127.0.0.1", broker.port, client_id=r, client_num=2))
+        accs = [h["acc"] for h in agg.test_history]
+        assert len(accs) == 2 and all(np.isfinite(a) for a in accs)
+
+    def test_reconnect_after_broker_restart(self):
+        """Broker dies and comes back on the same port: the socket client
+        reconnects, refires on_connect (re-subscribing), and delivery
+        resumes — only in-flight QoS-0 messages are lost."""
+        import socket
+        import time
+
+        import fedml_tpu.comm.mqtt_broker as mb
+
+        # pick a fixed free port so the restarted broker is reachable at
+        # the same address the client dials
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+
+        broker = mb.MqttBroker(port)
+        server = mqtt_backend.MqttCommManager(
+            "127.0.0.1", port, client_id=0, client_num=1)
+        c1 = mqtt_backend.MqttCommManager(
+            "127.0.0.1", port, client_id=1, client_num=1)
+        time.sleep(0.3)
+        m1 = Message("up", 1, 0)
+        m1.add_params("x", 1)
+        c1.send_message(m1)
+        assert server._inbox.get(timeout=5).get("x") == 1
+
+        broker.close()
+        broker2 = None
+        deadline = time.time() + 10
+        while broker2 is None and time.time() < deadline:
+            try:
+                broker2 = mb.MqttBroker(port)
+            except OSError:   # old sockets still draining on the port
+                time.sleep(0.2)
+        assert broker2 is not None
+        deadline = time.time() + 10
+        got = None
+        while time.time() < deadline:
+            try:
+                m2 = Message("up", 1, 0)
+                m2.add_params("x", 2)
+                c1.send_message(m2)
+                got = server._inbox.get(timeout=1)
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert got is not None and got.get("x") == 2
+        broker2.close()
+        for m in (server, c1):
+            m.stop_receive_message()
+
+
 def test_mqtt_codec_applies(mqtt_env):
     """The MQTT send path honors the backend codec: a q8-configured client's
     upload arrives quantized (smaller payload, bounded error) and the server
