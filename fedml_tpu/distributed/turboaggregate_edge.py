@@ -79,6 +79,42 @@ def _unflatten_flat(flat, treedef, shapes, dtypes):
     return jax.tree.unflatten(treedef, out)
 
 
+def _ckpt_setup(server, cfg, fname: str) -> None:
+    """Checkpoint/resume wiring shared by both TA server managers
+    (mirrors fedavg_edge): server state = variables + round + history —
+    client mask RNGs need no persistence because the additive/BGW masks
+    cancel exactly in the field, so a resumed run's aggregate is
+    bit-identical whatever masks the restarted clients draw."""
+    import os
+
+    server._ckpt_path = None
+    if getattr(cfg, "checkpoint_dir", None):
+        os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+        server._ckpt_path = os.path.join(cfg.checkpoint_dir, fname)
+    server._ckpt_freq = int(getattr(cfg, "checkpoint_frequency", 10) or 10)
+    resume = getattr(cfg, "resume_from", None)
+    if resume:
+        from fedml_tpu.utils.checkpoint import load_checkpoint
+
+        state = load_checkpoint(resume)
+        server.variables = state["variables"]
+        server.round_idx = int(state["round_idx"])
+        for k, v in state["extra"].get("history", {}).items():
+            server.history[k] = list(v)
+
+
+def _ckpt_maybe(server) -> None:
+    if server._ckpt_path is None:
+        return
+    if (server.round_idx % server._ckpt_freq == 0
+            or server.round_idx >= server.round_num):
+        from fedml_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(server._ckpt_path, server.variables,
+                        round_idx=server.round_idx,
+                        extra={"history": server.history})
+
+
 def _groups(num_clients: int, group_size: int) -> list[list[int]]:
     """Round-robin grouping, identical to secure_weighted_sum's
     ``range(g, C, n_groups)`` (algorithms/turboaggregate.py:232)."""
@@ -106,9 +142,15 @@ class TAEdgeServerManager(ServerManager):
         self._treedef, self._shapes, self._dtypes = _unflatten_template(variables)
         counts = np.asarray(dataset.train_counts, np.float64)[: size - 1]
         self._weights = counts / counts.sum()
+        _ckpt_setup(self, args, "ta_server.ckpt")
 
     def run(self):
         self.register_message_receive_handlers()
+        if self.round_idx >= self.round_num:   # resumed a finished run
+            for rank in range(1, self.size):
+                self.send_message(Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
+            self.finish()
+            return
         self._send_sync()
         self.com_manager.handle_receive_message()
 
@@ -145,6 +187,7 @@ class TAEdgeServerManager(ServerManager):
             self.history["Test/Loss"].append(m.get("loss"))
             self.history["Train/Loss"].append(train_loss)
         self.round_idx += 1
+        _ckpt_maybe(self)
         if self.round_idx >= self.round_num:
             for rank in range(1, self.size):
                 self.send_message(Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
@@ -383,10 +426,14 @@ class TAThresholdServerManager(ServerManager):
         self._empty = 0
         self._gen = 0
         self._timer = RoundDeadlineTimer(comm, deadline, rank, KEY_ROUND)
+        _ckpt_setup(self, args, "ta_server.ckpt")
 
     # -- lifecycle ---------------------------------------------------------
     def run(self):
         self.register_message_receive_handlers()
+        if self.round_idx >= self.round_num:   # resumed a finished run
+            self._teardown()
+            return
         self._send_sync()
         self.com_manager.handle_receive_message()
 
@@ -540,6 +587,7 @@ class TAThresholdServerManager(ServerManager):
             self.history["Test/Loss"].append(m.get("loss"))
             self.history["Train/Loss"].append(train_loss)
         self.round_idx += 1
+        _ckpt_maybe(self)
         if self.round_idx >= self.round_num:
             self._teardown()
             return
@@ -707,12 +755,7 @@ def run_turboaggregate_edge(dataset, config, group_size: int = 2,
     variables0 = jax.tree.map(np.asarray, bundle.init(root_key))
     size = C + 1
 
-    class Args:
-        pass
-
-    args = Args()
-    args.comm_round = config.comm_round
-    args.frequency_of_the_test = config.frequency_of_the_test
+    args = config  # carries comm_round / frequency_of_the_test / ckpt knobs
 
     holder = {}
     deadline = getattr(config, "straggler_deadline_sec", None)

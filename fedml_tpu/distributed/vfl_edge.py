@@ -40,6 +40,7 @@ MSG_TYPE_G2H_GRAD = "vfl_grad"         # guest -> host: common gradient
 MSG_TYPE_G2H_EVAL = "vfl_eval"         # guest -> host: test components request
 MSG_TYPE_H2G_EVAL_COMP = "vfl_eval_comp"
 MSG_TYPE_G2H_FINISH = "vfl_finish"
+MSG_TYPE_G2H_CKPT = "vfl_ckpt"         # guest -> host: persist party state now
 
 KEY_IDX = "idx"
 KEY_U = "u"
@@ -51,18 +52,48 @@ class VFLHostManager(ClientManager):
     slice and a VFLHostParty; answers batches with components, learns from
     the common gradient."""
 
-    def __init__(self, args, comm, rank, size, party: VFLHostParty, x_train, x_test):
+    def __init__(self, args, comm, rank, size, party: VFLHostParty, x_train,
+                 x_test, state_path=None, resume=False):
         super().__init__(args, comm, rank, size)
         self.party = party
         self.x_train = np.asarray(x_train)
         self.x_test = np.asarray(x_test)
+        # per-party state persistence: hosts OWN their feature-slice model
+        # (raw params never travel), so resume must restore it locally —
+        # the GKT-client pattern (fedgkt_edge.py)
+        self._state_path = state_path
+        if resume and state_path is not None:
+            import os
+
+            if os.path.exists(state_path):
+                from fedml_tpu.core.serialization import tree_from_bytes
+
+                with open(state_path, "rb") as f:
+                    st = tree_from_bytes(f.read())
+                self.party.params = st["params"]
+                self.party.opt_state = st["opt"]
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_TYPE_G2H_BATCH, self._on_batch)
         self.register_message_receive_handler(MSG_TYPE_G2H_GRAD, self._on_grad)
         self.register_message_receive_handler(MSG_TYPE_G2H_EVAL, self._on_eval)
+        self.register_message_receive_handler(MSG_TYPE_G2H_CKPT, self._on_ckpt)
         self.register_message_receive_handler(MSG_TYPE_G2H_FINISH,
                                               lambda m: self.finish())
+
+    def _on_ckpt(self, msg: Message):
+        if self._state_path is None:
+            return
+        from fedml_tpu.core.serialization import tree_to_bytes
+
+        blob = tree_to_bytes({"params": self.party.params,
+                              "opt": self.party.opt_state})
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        import os
+
+        os.replace(tmp, self._state_path)
 
     def _on_batch(self, msg: Message):
         idx = np.asarray(msg.get(KEY_IDX), np.int64)
@@ -86,7 +117,8 @@ class VFLGuestManager(ServerManager):
     vfl_api.py:16-42): owns the labels, fuses components, broadcasts the
     common gradient, drives the epoch/batch schedule of VFLAPI.fit."""
 
-    def __init__(self, args, comm, rank, size, party: VFLGuestParty, dataset):
+    def __init__(self, args, comm, rank, size, party: VFLGuestParty, dataset,
+                 ckpt_path=None, resume_from=None):
         super().__init__(args, comm, rank, size)
         self.party = party
         self.dataset = dataset
@@ -97,13 +129,32 @@ class VFLGuestManager(ServerManager):
         self._order_rng = np.random.default_rng(args.seed)
         self.epoch = 0
         self.step = 0
+        self._ckpt_path = ckpt_path
+        if resume_from:
+            from fedml_tpu.utils.checkpoint import load_checkpoint
+
+            state = load_checkpoint(resume_from)
+            self.party.params = state["variables"]["params"]
+            self.party.opt_state = state["variables"]["opt"]
+            self.epoch = int(state["round_idx"])
+            self.losses_resumed = list(state["extra"].get("losses", []))
+            # the epoch permutation stream is stateful: fast-forward past
+            # the completed epochs so the resumed order matches the
+            # uninterrupted run's
+            for _ in range(self.epoch):
+                self._order_rng.permutation(n)
         self._components: dict[int, np.ndarray] = {}
         self._eval_components: dict[int, np.ndarray] = {}
-        self.losses: list[float] = []
+        self.losses: list[float] = list(getattr(self, "losses_resumed", []))
         self.history: list[dict] = []
 
     def run(self):
         self.register_message_receive_handlers()
+        if self.epoch >= self.epochs:   # resumed a finished run: eval only
+            for rank in range(1, self.size):
+                self.send_message(Message(MSG_TYPE_G2H_EVAL, self.rank, rank))
+            self.com_manager.handle_receive_message()
+            return
         self._next_epoch_order()
         self._send_batch()
         self.com_manager.handle_receive_message()
@@ -155,6 +206,7 @@ class VFLGuestManager(ServerManager):
         self.losses.append(float(np.mean(self._epoch_losses)))
         self.epoch += 1
         self.step = 0
+        self._maybe_checkpoint()
         if self.epoch < self.epochs:
             self._next_epoch_order()
             self._send_batch()
@@ -162,6 +214,19 @@ class VFLGuestManager(ServerManager):
         # training done -> distributed eval
         for rank in range(1, self.size):
             self.send_message(Message(MSG_TYPE_G2H_EVAL, self.rank, rank))
+
+    def _maybe_checkpoint(self):
+        if self._ckpt_path is None:
+            return
+        from fedml_tpu.utils.checkpoint import save_checkpoint
+
+        for rank in range(1, self.size):
+            self.send_message(Message(MSG_TYPE_G2H_CKPT, self.rank, rank))
+        save_checkpoint(self._ckpt_path,
+                        {"params": self.party.params,
+                         "opt": self.party.opt_state},
+                        round_idx=self.epoch,
+                        extra={"losses": list(self.losses)})
 
     def _on_eval_component(self, msg: Message):
         self._eval_components[msg.get_sender_id()] = np.asarray(msg.get(KEY_U))
@@ -186,7 +251,8 @@ class VFLGuestManager(ServerManager):
 def run_vfl_edge(dataset, hidden_dim: int = 16, lr: float = 0.01,
                  batch_size: int = 64, epochs: int = 10, seed: int = 0,
                  wire_roundtrip: bool = True, comm_factory=None,
-                 straggler_deadline_sec=None):
+                 straggler_deadline_sec=None, checkpoint_dir=None,
+                 resume: bool = False):
     """Launch guest (rank 0) + one host per remaining party over the local
     transport (or gRPC via ``comm_factory``). Same init derivation as
     build_protocol_vfl(seed) and same batch schedule as VFLAPI.fit(epochs,
@@ -227,13 +293,28 @@ def run_vfl_edge(dataset, hidden_dim: int = 16, lr: float = 0.01,
     args.seed = seed
 
     holder = {}
+    guest_ckpt = host_path = None
+    if checkpoint_dir is not None:
+        import os
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        guest_ckpt = os.path.join(checkpoint_dir, "vfl_guest.ckpt")
+
+        def host_path(rank):
+            return os.path.join(checkpoint_dir, f"vfl_host_{rank}.state")
 
     def make(rank, comm):
         if rank == 0:
-            holder["guest"] = VFLGuestManager(args, comm, rank, size, guest, dataset)
+            holder["guest"] = VFLGuestManager(
+                args, comm, rank, size, guest, dataset,
+                ckpt_path=guest_ckpt,
+                resume_from=guest_ckpt if (resume and guest_ckpt) else None)
             return holder["guest"]
         return VFLHostManager(args, comm, rank, size, hosts[rank],
-                              dataset.train_parts[rank], dataset.test_parts[rank])
+                              dataset.train_parts[rank],
+                              dataset.test_parts[rank],
+                              state_path=host_path(rank) if host_path else None,
+                              resume=resume)
 
     run_ranks(make, size, wire_roundtrip=wire_roundtrip,
               comm_factory=comm_factory)
