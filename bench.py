@@ -95,7 +95,8 @@ EPOCHS = 1
 MEASURE_ROUNDS = 5
 
 
-def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
+def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int,
+                     clients_override: int | None = None):
     """Cross-silo distributed FedAvg on the same chip: full participation
     over a 1-device 'clients' mesh, resident-sharded data, psum aggregation.
     Reports its own real-images/sec so the mesh path's overhead vs the
@@ -132,7 +133,8 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
     # BENCH_CS_CLIENTS: silo-count override for the weak-scaling fit
     # (docs/perf.md): per-client records stay constant, so round compute
     # scales with the count and T(c) = a + b*c can be fitted from whole runs.
-    clients = 4 if tiny else int(os.environ.get("BENCH_CS_CLIENTS", NUM_CLIENTS))
+    clients = 4 if tiny else int(
+        clients_override or os.environ.get("BENCH_CS_CLIENTS", NUM_CLIENTS))
     records = 8 if tiny else RECORDS_PER_CLIENT
     ds = make_synthetic_classification(
         "cifar10-bench-cs", (32, 32, 3), 10, clients,
@@ -152,6 +154,9 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int):
         # packed mesh schedule: 2 lanes/device measured best at 32 silos
         # (docs/mfu_experiments.md H5); 0 restores the grouped schedule
         pack_lanes=int(os.environ.get("BENCH_PACK_LANES_CS", "2")),
+        # super-step: fold H rounds into one scanned program (H7 lever;
+        # H=rounds makes the measured pass exactly one program)
+        rounds_per_step=int(os.environ.get("BENCH_CS_SUPERSTEP", "1")),
         # force residency even on the CPU smoke path so tiny mode exercises
         # the same resident-sharded branch the TPU run measures
         device_data="on",
@@ -351,6 +356,39 @@ def main():
     if not os.environ.get("BENCH_NO_CROSSDEVICE"):
         crossdevice = _bench_crossdevice(tiny)
 
+    # Weak-scaling regression pin (VERDICT r4 #8): measure T(c) at c=8/16
+    # next to the 32-silo row above, fit T(c) = a + b*c through the
+    # endpoints, and check the midpoint against the fit — model drift or a
+    # perf regression in the mesh round shows up as a failed tolerance in
+    # the artifact itself (docs/perf.md weak-scaling section).
+    weak_scaling = None
+    if (crosssilo and not tiny and crosssilo["clients"] > 16
+            and not os.environ.get("BENCH_NO_WEAKSCALING")):
+        c_hi = crosssilo["clients"]   # respect a BENCH_CS_CLIENTS override
+        pts = {c_hi: 1.0 / crosssilo["rounds_per_sec"]}
+        for c in (8, 16):
+            row = _bench_crosssilo(tiny, model, rounds, batch,
+                                   clients_override=c)
+            pts[c] = 1.0 / row["rounds_per_sec"]
+        b = (pts[c_hi] - pts[8]) / (c_hi - 8)
+        a = pts[8] - b * 8
+        pred16 = a + b * 16
+        err = abs(pred16 - pts[16]) / pts[16]
+        weak_scaling = {
+            "round_seconds": {str(c): round(t, 4) for c, t in pts.items()},
+            "fit_overhead_ms": round(a * 1e3, 2),
+            "fit_per_silo_ms": round(b * 1e3, 2),
+            "midpoint_pred_s": round(pred16, 4),
+            "midpoint_err": round(err, 4),
+            "ok": bool(err < 0.15),
+        }
+        if not weak_scaling["ok"]:
+            import sys
+
+            print(f"WEAK-SCALING DRIFT: midpoint error {err:.1%} exceeds "
+                  f"15% — T(c) is no longer linear in silos; investigate",
+                  file=sys.stderr)
+
     result = {
         "metric": f"fedavg_local_sgd_images_per_sec ({model}, CIFAR-10 shapes, 32 non-IID clients, 8/round, bf16)",
         "value": round(img_per_sec, 1),
@@ -362,6 +400,7 @@ def main():
         "mfu": mfu,
         "crosssilo": crosssilo,
         "crossdevice": crossdevice,
+        "weak_scaling": weak_scaling,
         # mfu is an ESTIMATE: fwd FLOPs from XLA's cost model on the named
         # backend x3 for the train step, over the bf16 peak of the matched
         # spec-table entry — provenance recorded so a cost-model change or a

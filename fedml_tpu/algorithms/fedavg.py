@@ -164,13 +164,9 @@ class FedAvgAPI:
         ):
             return None
         if cast_bf16:
-            # cast on HOST (numpy + ml_dtypes) so the array stays host-side:
-            # the caller's device_put then ships each shard straight to its
-            # device — a jnp cast here would materialize the whole array on
-            # one device first and OOM exactly the sharded-placement case
-            import ml_dtypes
+            from fedml_tpu.utils.dtypes import host_bf16_cast
 
-            return x.astype(ml_dtypes.bfloat16)
+            return host_bf16_cast(x, c.dtype)
         return x
 
     # -- factory methods subclasses override ---------------------------------
@@ -936,6 +932,55 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
 
         return round_step
 
+    def _superstep_h(self) -> int:
+        """Effective super-step length: disabled (1) when per-round eval or
+        checkpointing would land MID-block — inside a block self.variables
+        holds the block-end state, so a mid-block eval would report a
+        future model and a mid-block checkpoint would double-apply rounds
+        on resume (review r5)."""
+        h = self.config.rounds_per_step
+        if h <= 1:
+            return 1
+        c = self.config
+        if getattr(c, "checkpoint_dir", None) or getattr(c, "resume_from", None):
+            if not getattr(self, "_warned_ss", False):
+                log.warning("rounds_per_step=%d ignored: checkpointing "
+                            "needs per-round state", h)
+                self._warned_ss = True
+            return 1
+        if c.frequency_of_the_test % h != 0:
+            if not getattr(self, "_warned_ss", False):
+                log.warning(
+                    "rounds_per_step=%d ignored: frequency_of_the_test=%d "
+                    "is not a multiple, so evals would land mid-block",
+                    h, c.frequency_of_the_test)
+                self._warned_ss = True
+            return 1
+        return h
+
+    def _packed_superstep_fn(self, h: int):
+        """One jitted program running ``h`` packed rounds as a lax.scan over
+        round keys — the fixed per-round cost (dispatch, program prologue,
+        aggregation tail serialization) is paid once per h rounds instead of
+        every round (the weak-scaling intercept lever, docs/perf.md)."""
+        pm = self._packed_mesh
+        inner = pm["round_fn"]
+
+        @jax.jit
+        def super_fn(variables, server_state, tx, ty, tm, w_dev, perm, rks,
+                     plan_arrays):
+            def body(carry, rk):
+                v, s = carry
+                v, s, loss = inner(v, s, tx, ty, tm, w_dev, perm, rk,
+                                   plan_arrays)
+                return (v, s), loss
+
+            (v, s), losses = jax.lax.scan(body, (variables, server_state),
+                                          rks)
+            return v, s, losses
+
+        return super_fn
+
     def run_round(self, round_idx: int) -> float:
         if self._packed_mesh is not None:
             from fedml_tpu.parallel.mesh import shard_client_batch
@@ -943,6 +988,38 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
             pm = self._packed_mesh
             live = self._sample_failures(round_idx, self.dataset.num_clients)
             w = pm["counts_perm"]
+            h = self._superstep_h()
+            if h > 1 and live is None:
+                # super-step block: round_idx falls in block
+                # [start, start+h); compute the whole block once, hand out
+                # the cached per-round device losses. A block's FIRST round
+                # always recomputes, so re-running the same rounds (the
+                # bench's warm+timed passes) re-executes like the plain path.
+                if not hasattr(self, "_ss_base"):
+                    self._ss_base = round_idx
+                start = ((round_idx - self._ss_base) // h) * h + self._ss_base
+                # the tail block is clamped so the scan NEVER trains rounds
+                # past the federation's total (review r5: comm_round % h)
+                done_before = start - self._ss_base
+                blk = min(h, self.config.comm_round - done_before)
+                cached = getattr(self, "_ss_cache", None)
+                if cached is None or cached[0] != start or round_idx == start:
+                    fns = getattr(self, "_ss_fns", None)
+                    if fns is None:
+                        fns = self._ss_fns = {}
+                    if blk not in fns:
+                        fns[blk] = self._packed_superstep_fn(blk)
+                    rks = jnp.stack([round_key(self.root_key, start + i)
+                                     for i in range(blk)])
+                    (w_dev,) = shard_client_batch(self.mesh, (w,))
+                    self.variables, self.server_state, losses = fns[blk](
+                        self.variables, self.server_state, *pm["data"],
+                        w_dev, jnp.asarray(pm["perm"], jnp.int32), rks,
+                        pm["plan_arrays"])
+                    self._ss_cache = cached = (start, losses)
+                train_loss = cached[1][round_idx - start]
+                return (train_loss if self.config.async_rounds
+                        else float(train_loss))
             if live is not None:
                 w = w * np.asarray(live, np.float32)[pm["perm"]]
             rk = round_key(self.root_key, round_idx)

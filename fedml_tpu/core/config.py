@@ -148,6 +148,13 @@ class FedConfig:
     # algorithm's aggregation to be the plain weighted mean (falls back
     # with a warning otherwise).
     pack_lanes: int = 0
+    # Cross-silo super-step: fold H consecutive rounds into ONE jitted
+    # program (lax.scan over round keys) on the packed resident-sharded
+    # mesh path — amortizes the fixed per-round cost (dispatch + program
+    # prologue/epilogue, the weak-scaling intercept of docs/perf.md) over
+    # H rounds. Requires full participation without failure injection;
+    # per-round losses still come back individually. 1 = off.
+    rounds_per_step: int = 1
     # lax.scan unroll factor for the local-SGD minibatch loop: XLA fuses
     # across adjacent steps (amortizing per-step loop/weight-traffic
     # overheads) without changing the math — same updates in the same
@@ -204,6 +211,9 @@ class FedConfig:
             raise ValueError(f"bucket_groups must be >= 1, got {self.bucket_groups}")
         if self.pack_lanes < 0:
             raise ValueError(f"pack_lanes must be >= 0, got {self.pack_lanes}")
+        if self.rounds_per_step < 1:
+            raise ValueError(
+                f"rounds_per_step must be >= 1, got {self.rounds_per_step}")
         if self.checkpoint_frequency < 1:
             raise ValueError(
                 f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"

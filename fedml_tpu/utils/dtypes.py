@@ -1,0 +1,17 @@
+"""Host-side dtype policy helpers shared across trainers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def host_bf16_cast(x: np.ndarray, config_dtype: str) -> np.ndarray:
+    """Cast float train data to bf16 ON HOST when training in bf16 — the
+    cast happens before device_put so each shard ships straight to its
+    device (a jnp cast would materialize the full array on one device
+    first). No-op for non-float data or non-bf16 configs."""
+    if config_dtype == "bfloat16" and np.issubdtype(x.dtype, np.floating):
+        import ml_dtypes
+
+        return x.astype(ml_dtypes.bfloat16)
+    return x

@@ -1,0 +1,65 @@
+"""Pins the committed accuracy artifact's structure (VERDICT r4 #5).
+
+``accuracy_run.json`` v2 is produced on the real chip by
+tools/accuracy_run.py at a difficulty calibrated NOT to saturate
+(class-separation + symmetric label noise -> an irreducible accuracy
+ceiling). This test gates on the artifact and asserts the reference
+benchmark's structural result — IID > non-IID at the fixed round budget
+(benchmark/README.md:105: 93.19 vs 87.12) — plus non-saturation, so a
+regenerated artifact that drifts back to the trivial 100%-by-round-30
+operating point fails CI.
+"""
+
+import json
+import os
+
+import pytest
+
+ART = os.path.join(os.path.dirname(__file__), "..", "accuracy_run.json")
+
+
+@pytest.fixture(scope="module")
+def art():
+    if not os.path.exists(ART):
+        pytest.skip("accuracy_run.json not generated on this host")
+    with open(ART) as f:
+        d = json.load(f)
+    if "fed_iid" not in d:
+        pytest.skip("v1 artifact (pre round-5 three-arm format)")
+    return d
+
+
+def test_curves_present_and_long(art):
+    for arm in ("centralized", "fed_iid", "fed_noniid"):
+        assert len(art[arm]["Test/Acc"]) >= 5
+    assert art["config"]["comm_round"] >= 100
+
+
+def test_not_saturated(art):
+    """The r4 artifact hit 100% by round 30 — parity at a trivial operating
+    point. v2's ceiling comes from label noise; nothing may reach it."""
+    ceiling = art["difficulty"]["noise_ceiling_acc"]
+    assert ceiling < 0.9
+    for arm in ("centralized", "fed_iid", "fed_noniid"):
+        assert max(art[arm]["Test/Acc"]) <= ceiling + 0.02
+        assert max(art[arm]["Test/Acc"]) < 0.999
+
+
+def test_reference_structure_iid_beats_noniid(art):
+    """The headline structural gap: at the fixed budget, fed-IID ends above
+    fed-non-IID by a real margin, and centralized >= fed-IID (within one
+    eval-noise step)."""
+    iid = art["fed_iid"]["Test/Acc"][-1]
+    noniid = art["fed_noniid"]["Test/Acc"][-1]
+    cen = art["centralized"]["Test/Acc"][-1]
+    assert iid > noniid + 0.02, (iid, noniid)
+    assert cen >= iid - 0.03, (cen, iid)
+
+
+def test_curves_actually_learned(art):
+    """All three arms beat chance by a wide margin — the difficulty knob
+    made the task non-saturating, not unlearnable."""
+    for arm in ("centralized", "fed_iid", "fed_noniid"):
+        accs = art[arm]["Test/Acc"]
+        assert accs[-1] > 0.4, (arm, accs[-1])
+        assert accs[-1] > accs[0] + 0.2
