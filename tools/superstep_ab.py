@@ -1,4 +1,4 @@
-"""H7 A/B driver: per-round dispatch vs the scanned super-step on the
+"""H7 A/B driver (bench warms twice per pass — see _bench_crosssilo): per-round dispatch vs the scanned super-step on the
 packed cross-silo mesh path, at two silo counts.
 
 Each cell is a whole _bench_crosssilo run (the tunnel measurement
