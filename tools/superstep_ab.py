@@ -1,5 +1,6 @@
-"""H7 A/B driver (bench warms twice per pass — see _bench_crosssilo): per-round dispatch vs the scanned super-step on the
-packed cross-silo mesh path, at two silo counts.
+"""H7 A/B driver: per-round dispatch vs the scanned super-step on the
+packed cross-silo mesh path, at two silo counts. (_bench_crosssilo warms
+two full passes — see docs/mfu_experiments.md H7 pitfall #2.)
 
 Each cell is a whole _bench_crosssilo run (the tunnel measurement
 protocol); the fixed per-round overhead is the weak-scaling intercept
