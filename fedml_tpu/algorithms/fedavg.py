@@ -595,6 +595,13 @@ class FedAvgAPI:
             cx, cy, cm, counts = self.dataset.client_slice(sampled)
             if bucket is not None:
                 cx, cy, cm = cx[:, :bucket], cy[:, :bucket], cm[:, :bucket]
+            # bf16 training casts on device anyway — casting on HOST first
+            # halves the per-round uplink (the dominant cost for big-input
+            # host-path rounds, e.g. the 342k-client cross-device row's
+            # 140 MB/round of 10k-dim features)
+            from fedml_tpu.utils.dtypes import host_bf16_cast
+
+            cx = host_bf16_cast(np.asarray(cx), self.config.dtype)
             counts = np.asarray(counts, np.float32)
             if live is not None:
                 counts = counts * live
