@@ -168,6 +168,28 @@ class TestSplitNNManagedRing:
         assert len(server.val_history) == 4
         assert server.ring_alive == {1: True, 2: False, 3: True}
 
+    def test_silent_client_skipped_over_grpc(self, monkeypatch):
+        """The same skip-and-re-form over real gRPC sockets."""
+        pytest.importorskip("grpc")
+        from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+        class Silent(se.SplitNNEdgeClientManager):
+            def handle_semaphore(self, msg):
+                if self.rank == 2:
+                    return
+                super().handle_semaphore(msg)
+
+        monkeypatch.setattr(se, "SplitNNEdgeClientManager", Silent)
+        ds, _, cb, sb = self._setup()
+        cfg = FedConfig(batch_size=10, lr=0.1, momentum=0.9, epochs=1,
+                        seed=0, straggler_deadline_sec=6.0)
+        server = se.run_splitnn_edge(
+            ds, cfg, cb, sb,
+            comm_factory=lambda r: GRPCCommManager(rank=r, size=4,
+                                                   base_port=56890))
+        assert len(server.val_history) == 2
+        assert server.ring_alive == {1: True, 2: False, 3: True}
+
     def test_vfl_keeps_strict_barrier_with_warning(self, caplog):
         """VFL cannot drop a party (feature-split forward needs all
         embeddings): the deadline is warned about and ignored."""
