@@ -329,6 +329,10 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--bucket_quantum_batches", type=int,
                    default=defaults.bucket_quantum_batches)
     p.add_argument("--bucket_groups", type=int, default=defaults.bucket_groups)
+    p.add_argument("--rounds_per_step", type=int,
+                   default=defaults.rounds_per_step,
+                   help="fold H cross-silo rounds into one scanned program "
+                        "(docs/mfu_experiments.md H7); 1 = off")
     p.add_argument("--pack_lanes", type=int, default=defaults.pack_lanes,
                    help="pack the cohort into N scan lanes (0 = off)")
     p.add_argument("--scan_unroll", type=int, default=defaults.scan_unroll)
