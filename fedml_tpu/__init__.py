@@ -28,4 +28,22 @@ Layer map (mirrors SURVEY.md §1):
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):
+    # jax < 0.6 ships shard_map under experimental; the codebase (and its
+    # tests) import the stable ``jax.shard_map`` spelling everywhere, so
+    # alias it once here — every module imports this package first
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _jax.shard_map = _shard_map
+
+if not hasattr(_jax.lax, "pcast"):
+    # jax < 0.7 has no varying/replicated cast op: its shard_map tracks
+    # replication itself, so marking a value "varying" is the identity there
+    def _pcast(x, axis_name=None, to=None):  # noqa: ARG001 - newer-jax sig
+        return x
+
+    _jax.lax.pcast = _pcast
+
 from fedml_tpu.core import aggregation, partition, pytree  # noqa: F401

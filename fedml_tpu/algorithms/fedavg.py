@@ -681,13 +681,21 @@ class FedAvgAPI:
         logger.close()
         return self.history
 
+    def _eval_at(self, r: int) -> bool:
+        """Whether to run the periodic eval after round ``r`` (self.variables
+        holds the post-round-r model at that point). Subclasses whose
+        run_round advances state in blocks (super-step) override this to
+        align evals to block ends."""
+        c = self.config
+        return r % c.frequency_of_the_test == 0 or r == c.comm_round - 1
+
     def _train_rounds(self, start_round, timer, logger):
         c = self.config
         for r in range(start_round, c.comm_round):
             with timer.phase("train"):
                 loss = self.run_round(r)
             timer.tick_round()
-            if r % c.frequency_of_the_test == 0 or r == c.comm_round - 1:
+            if self._eval_at(r):
                 with timer.phase("eval"):
                     m = self.evaluate_global()
                 self.history["round"].append(r)
@@ -940,11 +948,14 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         return round_step
 
     def _superstep_h(self) -> int:
-        """Effective super-step length: disabled (1) when per-round eval or
-        checkpointing would land MID-block — inside a block self.variables
-        holds the block-end state, so a mid-block eval would report a
-        future model and a mid-block checkpoint would double-apply rounds
-        on resume (review r5)."""
+        """Effective super-step length: disabled (1) when checkpointing
+        would land MID-block — inside a block self.variables holds the
+        block-end state, so a mid-block checkpoint would double-apply
+        rounds on resume (review r5). Periodic evals no longer disable the
+        super-step: _eval_at aligns them to block ends with true round
+        labels (ADVICE r5 medium — the old block-START guard reported the
+        post-block model under the start round's label, shifting
+        convergence curves by h-1 rounds)."""
         h = self.config.rounds_per_step
         if h <= 1:
             return 1
@@ -955,15 +966,30 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                             "needs per-round state", h)
                 self._warned_ss = True
             return 1
-        if c.frequency_of_the_test % h != 0:
-            if not getattr(self, "_warned_ss", False):
-                log.warning(
-                    "rounds_per_step=%d ignored: frequency_of_the_test=%d "
-                    "is not a multiple, so evals would land mid-block",
-                    h, c.frequency_of_the_test)
-                self._warned_ss = True
-            return 1
         return h
+
+    def _eval_at(self, r: int) -> bool:
+        """Super-step blocks advance self.variables to the BLOCK-END state
+        on the block's first round, so evals only run at block ends — at
+        which point self.variables is exactly the post-round-r model — and
+        a block end evals iff its block contains a round the plain-path
+        schedule would have evaluated (or it is the final round)."""
+        h = self._superstep_h()
+        if h <= 1 or self._packed_mesh is None:
+            return super()._eval_at(r)
+        c = self.config
+        if c.failure_prob:
+            # failure injection forces run_round onto the per-round path
+            # (live mask every round), so variables ARE post-round-r state
+            # at every r — keep the plain eval schedule
+            return super()._eval_at(r)
+        if r == c.comm_round - 1:
+            return True
+        base = getattr(self, "_ss_base", 0)
+        if (r - base + 1) % h != 0:
+            return False               # mid-block: variables are from the future
+        start = r - h + 1
+        return any(k % c.frequency_of_the_test == 0 for k in range(start, r + 1))
 
     def _packed_superstep_fn(self, h: int):
         """One jitted program running ``h`` packed rounds as a lax.scan over

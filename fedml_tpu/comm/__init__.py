@@ -25,6 +25,11 @@ from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.local import LocalCommunicationManager, LocalRouter
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.reliable import (
+    ReliableCommManager,
+    build_wire_stack,
+    wire_wrap_factory,
+)
 
 
 def create_comm_manager(backend: str, **kwargs):
@@ -53,5 +58,8 @@ __all__ = [
     "LocalRouter",
     "ClientManager",
     "ServerManager",
+    "ReliableCommManager",
+    "build_wire_stack",
+    "wire_wrap_factory",
     "create_comm_manager",
 ]

@@ -50,6 +50,13 @@ class BaseCommunicationManager(abc.ABC):
         the receive loop instead of racing it from another thread."""
         raise NotImplementedError(f"{type(self).__name__} has no local injection")
 
+    def supports_local_injection(self) -> bool:
+        """Whether inject_local reaches a real delivery queue. Wrapper
+        transports (reliable/chaos) override this to ask the transport they
+        wrap — merely defining a delegating inject_local must not make a
+        non-injectable backend look injectable."""
+        return type(self).inject_local is not BaseCommunicationManager.inject_local
+
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
 

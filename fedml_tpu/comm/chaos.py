@@ -1,0 +1,206 @@
+"""Deterministic chaos injection for the edge transports.
+
+:class:`ChaosCommManager` wraps a bare transport and misbehaves like a real
+WAN on the SEND side: it drops, duplicates, delays, and reorders messages,
+and can crash-stop its rank after a configured number of sends (the
+killed-process failure model the straggler-deadline machinery exists for).
+
+Every fault decision is drawn from ``np.random.default_rng`` seeded by
+(chaos_seed, message identity, delivery attempt) — NOT from a shared
+stream — so the fate of each transmission is a pure function of the seed
+and the message, independent of thread interleaving: the retransmit thread
+racing the protocol thread cannot change which copies the wire eats. A
+sweep over seeds (tools/chaos_sweep.py) is therefore reproducible.
+
+Chaos sits UNDER the reliable layer (comm/reliable.py): acks ride the same
+lossy wire, so a dropped ack exercises retransmit + dedup end to end.
+Config gates which faults are legal without the reliable layer on top —
+drop/dup/reorder would hang or double-count the message-counting barriers
+(core/config.py validation).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_WIRE_SEQ,
+    MSG_TYPE_WIRE_ACK,
+    Message,
+)
+
+LOG = logging.getLogger(__name__)
+
+CHAOS_RATE_FIELDS = ("chaos_drop", "chaos_dup", "chaos_delay_ms",
+                     "chaos_reorder")
+
+
+def chaos_enabled(config) -> bool:
+    if any(getattr(config, f, 0.0) for f in CHAOS_RATE_FIELDS):
+        return True
+    return getattr(config, "chaos_crash_rank", None) is not None
+
+
+class ChaosCommManager(BaseCommunicationManager, Observer):
+    def __init__(
+        self,
+        inner: BaseCommunicationManager,
+        drop: float = 0.0,
+        dup: float = 0.0,
+        delay_ms: float = 0.0,
+        reorder: float = 0.0,
+        seed: int = 0,
+        rank: int = 0,
+        crash_after_sends: Optional[int] = None,
+    ):
+        super().__init__(codec=inner.codec)
+        self.inner = inner
+        self.drop = float(drop)
+        self.dup = float(dup)
+        self.delay_ms = float(delay_ms)
+        self.reorder = float(reorder)
+        self.seed = int(seed)
+        self.rank = int(rank)
+        self.crash_after_sends = crash_after_sends
+        self._sends = 0
+        self._occurrence: dict = {}    # fate key -> times seen (attempt idx)
+        self._held = None              # reorder buffer: (msg, delay_s)
+        self._crashed = False
+        self._lock = threading.Lock()
+        self.stats = {
+            "sent": 0, "dropped": 0, "duplicated": 0, "delayed": 0,
+            "reordered": 0, "crashed_dropped": 0, "crash_stops": 0,
+        }
+        inner.add_observer(self)
+
+    # -- deterministic fate ------------------------------------------------
+    def _fate_rng(self, msg: Message) -> np.random.Generator:
+        """Per-(message, attempt) generator: the fate of attempt N of a given
+        logical message is fixed by the seed alone — thread timing between
+        the protocol and retransmit threads cannot reshuffle the draws."""
+        if msg.get_type() == MSG_TYPE_WIRE_ACK:
+            from fedml_tpu.comm.reliable import KEY_ACK_SEQ
+
+            ident = ("ack", msg.get_sender_id(), msg.get_receiver_id(),
+                     msg.get(KEY_ACK_SEQ))
+        else:
+            seq = msg.get(MSG_ARG_KEY_WIRE_SEQ)
+            ident = ("msg", msg.get_sender_id(), msg.get_receiver_id(),
+                     seq if seq is not None else str(msg.get_type()))
+        with self._lock:
+            attempt = self._occurrence.get(ident, 0)
+            self._occurrence[ident] = attempt + 1
+        digest = hashlib.blake2s(repr(ident).encode(), digest_size=8).digest()
+        return np.random.default_rng(
+            [self.seed, int.from_bytes(digest, "big"), attempt])
+
+    # -- send path ---------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        with self._lock:
+            if self._crashed:
+                self.stats["crashed_dropped"] += 1
+                return
+            self._sends += 1
+            crash_now = (self.crash_after_sends is not None
+                         and self._sends >= self.crash_after_sends)
+        # always burn all four draws so each decision is independent of the
+        # others' rates — changing one rate never re-deals the rest
+        r_drop, r_dup, r_reorder, u_delay = self._fate_rng(msg).random(4)
+        try:
+            if r_drop < self.drop:
+                with self._lock:   # counters race: concurrent retransmit sends
+                    self.stats["dropped"] += 1
+                return
+            copies = 2 if r_dup < self.dup else 1
+            if copies == 2:
+                with self._lock:
+                    self.stats["duplicated"] += 1
+            delay_s = (u_delay * self.delay_ms / 1000.0) if self.delay_ms else 0.0
+            for _ in range(copies):
+                self._dispatch(msg, r_reorder < self.reorder, delay_s)
+        finally:
+            if crash_now:
+                self._crash()
+
+    def _dispatch(self, msg: Message, reorder_hit: bool, delay_s: float) -> None:
+        to_send = []
+        with self._lock:
+            if reorder_hit and self._held is None:
+                self._held = (msg, delay_s)
+                self.stats["reordered"] += 1
+            else:
+                to_send.append((msg, delay_s))
+                if self._held is not None:
+                    to_send.append(self._held)
+                    self._held = None
+        for m, d in to_send:
+            self._send_later(m, d)
+
+    def _send_later(self, msg: Message, delay_s: float) -> None:
+        if delay_s <= 0.0:
+            with self._lock:
+                self.stats["sent"] += 1
+            self.inner.send_message(msg)
+            return
+
+        def fire():
+            try:
+                self.inner.send_message(msg)
+            except Exception as e:  # delayed send to a gone peer: wire loss
+                LOG.debug("chaos rank %d: delayed send failed (%s)",
+                          self.rank, e)
+
+        with self._lock:
+            self.stats["delayed"] += 1
+            self.stats["sent"] += 1
+        t = threading.Timer(delay_s, fire)
+        t.daemon = True
+        t.start()
+
+    def _crash(self) -> None:
+        """Crash-stop this rank: go silent in both directions and exit the
+        receive loop — the in-process equivalent of kill -9, the failure the
+        straggler deadline + JOIN/rejoin machinery handles."""
+        with self._lock:
+            if self._crashed:
+                return
+            self._crashed = True
+            self._held = None
+            self.stats["crash_stops"] += 1
+        LOG.warning("chaos: rank %d crash-stopped after %d sends",
+                    self.rank, self._sends)
+        self.inner.stop_receive_message()
+
+    # -- receive path ------------------------------------------------------
+    def receive_message(self, msg_type, msg: Message) -> None:
+        if self._crashed:
+            return
+        self._notify(msg)
+
+    # -- lifecycle ---------------------------------------------------------
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        with self._lock:
+            held, self._held = self._held, None
+        if held is not None and not self._crashed:
+            # a reorder hold with no follow-up send would turn reorder into
+            # silent drop at shutdown; flush it instead
+            try:
+                self.inner.send_message(held[0])
+            except Exception:
+                pass
+        self.inner.stop_receive_message()
+
+    def inject_local(self, msg: Message) -> None:
+        self.inner.inject_local(msg)
+
+    def supports_local_injection(self) -> bool:
+        return self.inner.supports_local_injection()

@@ -76,7 +76,8 @@ class LocalCommunicationManager(BaseCommunicationManager):
 
 
 def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
-              timeout: float = 300.0, comm_factory=None, codec: str = "raw"):
+              timeout: float = 300.0, comm_factory=None, codec: str = "raw",
+              wrap=None):
     """Launch ``size`` ranks on threads; rank r runs make_manager(r, comm).
 
     ``make_manager`` returns an object with ``.run()`` (typically a
@@ -89,16 +90,19 @@ def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
     builds LocalCommunicationManagers over one shared LocalRouter.
     ``codec`` sets the default transport's wire codec (compression); a
     comm_factory configures its own backends.
+    ``wrap(rank, comm) -> comm`` layers wire middleware (reliable delivery,
+    chaos injection — comm/reliable.py wire_wrap_factory) over whichever
+    transport was built, so every protocol gets it without code changes.
     """
     router = None if comm_factory else LocalRouter(size)
     comms: list[BaseCommunicationManager] = []
     try:
         for r in range(size):
-            comms.append(
-                comm_factory(r) if comm_factory
-                else LocalCommunicationManager(router, r,
-                                               wire_roundtrip=wire_roundtrip,
-                                               codec=codec))
+            c = (comm_factory(r) if comm_factory
+                 else LocalCommunicationManager(router, r,
+                                                wire_roundtrip=wire_roundtrip,
+                                                codec=codec))
+            comms.append(wrap(r, c) if wrap is not None else c)
         managers = [make_manager(r, comms[r]) for r in range(size)]
     except BaseException:
         # partial setup (e.g. a gRPC port already bound): release what was

@@ -22,6 +22,19 @@ from fedml_tpu.core.serialization import (
 
 _MAGIC = b"FMSG1"
 
+# Reliable-wire envelope (comm/reliable.py). The reliable layer stamps every
+# outgoing message with a per-(sender,receiver) monotonic sequence number and
+# a message id; receivers ack by id and dedup by (sender, seq). Handlers
+# never read these keys — an unstamped message (local control injection, or
+# a peer without the reliable layer) bypasses dedup and delivers directly.
+MSG_ARG_KEY_WIRE_SEQ = "__wire_seq__"
+MSG_ARG_KEY_WIRE_MID = "__wire_mid__"
+# incarnation id of the sending reliable layer: a restarted rank restarts
+# its seq stream at 0, so dedup keys on (sender, incarnation) — otherwise a
+# rejoining worker's first messages would be swallowed as duplicates
+MSG_ARG_KEY_WIRE_INC = "__wire_inc__"
+MSG_TYPE_WIRE_ACK = "__wire_ack__"
+
 # Canonical arg keys (reference message.py:15-35).
 MSG_ARG_KEY_TYPE = "msg_type"
 MSG_ARG_KEY_SENDER = "sender"

@@ -1,0 +1,335 @@
+"""Reliable wire delivery: at-least-once transport, exact-once handlers.
+
+The three edge transports (local/grpc/mqtt) are fire-and-forget; every
+message-driven protocol in distributed/ advances rounds by MESSAGE COUNTING
+(e.g. base_framework.handle_result), so one dropped message hangs a barrier
+and one duplicated message double-aggregates an upload. The reference
+inherits delivery guarantees from MPI; real cross-device FL (FedML
+arXiv:2007.13518) runs over a wire where loss, duplication, and reordering
+are the normal case.
+
+:class:`ReliableCommManager` wraps any BaseCommunicationManager and gives
+the federation at-least-once delivery with exact-once handling, with no
+per-protocol changes:
+
+- SEND stamps a per-(sender,receiver) monotonic sequence number plus a
+  message id, transmits synchronously (a transport-level send failure still
+  raises, so the fault-tolerant mark-dead path keeps working), and tracks
+  the message until acked — a retransmit thread re-sends with exponential
+  backoff up to a bounded retry count;
+- RECEIVE acks every stamped message on arrival, then dedups by
+  (sender, sender-incarnation, seq) inside a sliding window before
+  notifying observers, so a handler sees each logical message exactly once
+  no matter how many copies the wire (or the retransmitter) produced — and
+  a RESTARTED rank (fresh incarnation id, seq stream back at 0) is not
+  mistaken for its predecessor's duplicates;
+- STOP drains: the receive loop stays alive until outstanding sends are
+  acked, retries are exhausted, or a drain timeout passes — a FINISH lost
+  on a flaky wire is still retransmitted after the server decides it is
+  done, so no worker hangs at teardown.
+
+Acks are fire-and-forget (a lost ack just causes a retransmit that the
+dedup window absorbs). Unstamped messages — local control injections like
+the straggler-deadline timer, or peers without this layer — bypass both ack
+and dedup and deliver directly, which is also what makes a zero-fault
+reliable run deliver bit-identical message content in identical order to
+the bare transport (pinned by tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_WIRE_INC,
+    MSG_ARG_KEY_WIRE_MID,
+    MSG_ARG_KEY_WIRE_SEQ,
+    MSG_TYPE_WIRE_ACK,
+    Message,
+)
+
+LOG = logging.getLogger(__name__)
+
+KEY_ACK_MID = "ack_mid"
+KEY_ACK_SEQ = "ack_seq"
+
+
+class _Pending:
+    __slots__ = ("msg", "receiver", "attempts", "next_due", "in_flight")
+
+    def __init__(self, msg: Message, receiver: int, next_due: float):
+        self.msg = msg
+        self.receiver = receiver
+        self.attempts = 0          # retransmit attempts (first send excluded)
+        self.next_due = next_due
+        self.in_flight = False     # a retransmit send is currently executing
+
+
+class ReliableCommManager(BaseCommunicationManager, Observer):
+    """ACK/retransmit + dedup wrapper around any transport manager."""
+
+    def __init__(
+        self,
+        inner: BaseCommunicationManager,
+        rank: Optional[int] = None,
+        retry_base_s: float = 0.05,
+        retry_cap_s: float = 1.0,
+        retry_max: int = 10,
+        # covers full retry exhaustion (~6.6 s at the default schedule): the
+        # drain must outlive the retries it exists to host
+        drain_timeout_s: float = 8.0,
+        dedup_window: int = 4096,
+    ):
+        super().__init__(codec=inner.codec)
+        self.inner = inner
+        self.rank = int(rank if rank is not None else getattr(inner, "rank", 0))
+        self.retry_base_s = float(retry_base_s)
+        self.retry_cap_s = float(retry_cap_s)
+        self.retry_max = int(retry_max)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.dedup_window = int(dedup_window)
+        self._seq: Dict[int, int] = {}                 # receiver -> next seq
+        self._outstanding: Dict[str, _Pending] = {}    # mid -> pending send
+        # dedup state keyed on (sender, sender incarnation): a restarted
+        # rank restarts its seq stream, so each incarnation deduplicates
+        # independently instead of colliding with its predecessor's window
+        self._seen: Dict[tuple, set] = {}
+        self._inc = uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._stopping = False
+        self._closed = False
+        self.stats = {
+            "sent": 0, "retransmits": 0, "retransmit_errors": 0,
+            "gave_up": 0, "acked": 0, "acks_sent": 0,
+            "delivered": 0, "dup_dropped": 0,
+        }
+        inner.add_observer(self)
+        self._retx = threading.Thread(
+            target=self._retransmit_loop, daemon=True,
+            name=f"wire-retx-{self.rank}")
+        self._retx.start()
+
+    # -- send path ---------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        receiver = int(msg.get_receiver_id())
+        with self._cv:
+            if MSG_ARG_KEY_WIRE_SEQ not in msg:
+                seq = self._seq.get(receiver, 0)
+                self._seq[receiver] = seq + 1
+                msg.add_params(MSG_ARG_KEY_WIRE_SEQ, seq)
+                msg.add_params(MSG_ARG_KEY_WIRE_MID, uuid.uuid4().hex)
+                msg.add_params(MSG_ARG_KEY_WIRE_INC, self._inc)
+            mid = msg.get(MSG_ARG_KEY_WIRE_MID)
+            pend = _Pending(msg, receiver,
+                            time.monotonic() + self._backoff(0))
+            # in_flight from the start: the retry clock must not run while
+            # the initial (blocking) transmit is still serializing a large
+            # payload — otherwise every send slower than retry_base_s earns
+            # guaranteed spurious retransmits concurrent with itself
+            pend.in_flight = True
+            self._outstanding[mid] = pend
+            self.stats["sent"] += 1
+        try:
+            self.inner.send_message(msg)
+        except Exception:
+            # The transport itself refused the send (dead gRPC peer, closed
+            # broker socket): surface it to the caller exactly like the bare
+            # transport would — the fault-tolerant server's mark-dead path
+            # depends on that — and stop tracking; retransmits exist for
+            # SILENT loss, not for peers the transport already declared gone.
+            with self._cv:
+                self._outstanding.pop(mid, None)
+                self._cv.notify()
+            raise
+        with self._cv:
+            # retry clock starts at transmit COMPLETION (the ack may already
+            # have landed and popped the entry — then there is nothing to arm)
+            if mid in self._outstanding:
+                pend.in_flight = False
+                pend.next_due = time.monotonic() + self._backoff(0)
+            self._cv.notify()
+
+    def _backoff(self, attempt: int) -> float:
+        return min(self.retry_base_s * (2 ** attempt), self.retry_cap_s)
+
+    def _retransmit_loop(self) -> None:
+        while True:
+            due = []
+            with self._cv:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                wait = 0.25
+                for mid in list(self._outstanding):
+                    p = self._outstanding[mid]
+                    if p.in_flight:
+                        continue   # a previous attempt is still on the wire
+                    if p.next_due > now:
+                        wait = min(wait, p.next_due - now)
+                        continue
+                    p.attempts += 1
+                    if p.attempts > self.retry_max:
+                        self._outstanding.pop(mid)
+                        self.stats["gave_up"] += 1
+                        self._cv.notify_all()
+                        LOG.warning(
+                            "rank %d: message %r to %d unacked after %d "
+                            "retries; giving up", self.rank,
+                            p.msg.get_type(), p.receiver, self.retry_max)
+                        continue
+                    p.next_due = now + self._backoff(p.attempts)
+                    p.in_flight = True
+                    due.append(p)
+                if not due:
+                    self._cv.wait(timeout=wait)
+                    continue
+            # one thread per due message: a blocking transport (gRPC
+            # wait_for_ready on a dead peer) must not starve retransmits to
+            # LIVE peers — that starvation is exactly how a lost FINISH to
+            # one worker hangs the federation while another worker's corpse
+            # blocks the queue. in_flight keeps a wedged send from stacking
+            # repeat attempts for the same message.
+            for p in due:
+                threading.Thread(target=self._retransmit_one, args=(p,),
+                                 daemon=True,
+                                 name=f"wire-retx-{self.rank}-send").start()
+
+    def _retransmit_one(self, p: _Pending) -> None:
+        key = "retransmits"
+        try:
+            self.inner.send_message(p.msg)
+        except Exception as e:
+            key = "retransmit_errors"
+            LOG.debug("rank %d: retransmit to %s failed (%s)",
+                      self.rank, p.receiver, e)
+        finally:
+            # counter bumped under the lock: these threads run concurrently
+            with self._cv:
+                self.stats[key] += 1
+                p.in_flight = False
+                self._cv.notify_all()
+
+    # -- receive path (Observer of the inner transport) --------------------
+    def receive_message(self, msg_type, msg: Message) -> None:
+        if msg_type == MSG_TYPE_WIRE_ACK:
+            with self._cv:
+                if self._outstanding.pop(msg.get(KEY_ACK_MID), None) is not None:
+                    self.stats["acked"] += 1
+                    self._cv.notify_all()
+            return
+        seq = msg.get(MSG_ARG_KEY_WIRE_SEQ)
+        if seq is None:
+            # unstamped: local control injection (deadline timer) or a peer
+            # without the reliable layer — deliver directly
+            self._notify(msg)
+            return
+        sender = int(msg.get_sender_id())
+        with self._lock:
+            stopping = self._stopping
+        # ack BEFORE dispatch: the ack acknowledges receipt into the dedup
+        # layer (at-least-once), not handler completion. Once we are
+        # draining, stop acking: the peer that sent this is usually tearing
+        # down too, and a blocking transport (gRPC wait_for_ready) would
+        # pin the receive thread on a dead endpoint for its full send
+        # timeout per late retransmit — the sender's retries are bounded,
+        # so an unacked tail message resolves itself.
+        if not stopping:
+            ack = Message(MSG_TYPE_WIRE_ACK, self.rank, sender)
+            ack.add_params(KEY_ACK_MID, msg.get(MSG_ARG_KEY_WIRE_MID))
+            ack.add_params(KEY_ACK_SEQ, int(seq))
+            try:
+                self.inner.send_message(ack)
+                self.stats["acks_sent"] += 1
+            except Exception as e:  # lost == dropped ack: retransmit covers it
+                LOG.debug("rank %d: ack to %d failed (%s)", self.rank, sender, e)
+        with self._lock:
+            dup = self._is_dup_and_mark(
+                (sender, msg.get(MSG_ARG_KEY_WIRE_INC)), int(seq))
+        if dup:
+            self.stats["dup_dropped"] += 1
+            return
+        self.stats["delivered"] += 1
+        self._notify(msg)
+
+    def _is_dup_and_mark(self, sender: tuple, seq: int) -> bool:
+        seen = self._seen.setdefault(sender, set())
+        if seq in seen:
+            return True
+        seen.add(seq)
+        if len(seen) > self.dedup_window:
+            # bounded memory: anything this far behind the high-water mark
+            # can no longer be retransmitted (retries are bounded)
+            cutoff = max(seen) - self.dedup_window
+            self._seen[sender] = {s for s in seen if s >= cutoff}
+        return False
+
+    # -- lifecycle ---------------------------------------------------------
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        # Drain before stopping the inner loop: stop is usually called from
+        # a handler ON the receive thread, so the wait runs on a helper.
+        with self._cv:
+            if self._stopping:
+                return
+            self._stopping = True
+        threading.Thread(target=self._drain_and_stop, daemon=True,
+                         name=f"wire-drain-{self.rank}").start()
+
+    def _drain_and_stop(self) -> None:
+        deadline = time.monotonic() + self.drain_timeout_s
+        with self._cv:
+            while self._outstanding and time.monotonic() < deadline:
+                self._cv.wait(timeout=0.05)
+            self._closed = True
+            self._cv.notify_all()
+        self.inner.stop_receive_message()
+
+    def inject_local(self, msg: Message) -> None:
+        self.inner.inject_local(msg)
+
+    def supports_local_injection(self) -> bool:
+        return self.inner.supports_local_injection()
+
+
+def build_wire_stack(comm: BaseCommunicationManager, config,
+                     rank: int) -> BaseCommunicationManager:
+    """Wrap a bare transport per config: chaos injection innermost (it IS
+    the wire), the reliable layer on top (it recovers what chaos breaks)."""
+    from fedml_tpu.comm.chaos import ChaosCommManager, chaos_enabled
+
+    if chaos_enabled(config):
+        crash_after = (config.chaos_crash_after
+                       if getattr(config, "chaos_crash_rank", None) == rank
+                       else None)
+        comm = ChaosCommManager(
+            comm,
+            drop=getattr(config, "chaos_drop", 0.0),
+            dup=getattr(config, "chaos_dup", 0.0),
+            delay_ms=getattr(config, "chaos_delay_ms", 0.0),
+            reorder=getattr(config, "chaos_reorder", 0.0),
+            seed=getattr(config, "chaos_seed", 0),
+            rank=rank,
+            crash_after_sends=crash_after,
+        )
+    if getattr(config, "wire_reliable", False):
+        comm = ReliableCommManager(comm, rank=rank)
+    return comm
+
+
+def wire_wrap_factory(config):
+    """``(rank, comm) -> comm`` wrapper for run_ranks, or None when neither
+    the reliable layer nor chaos injection is configured (zero overhead —
+    the bare transports are returned untouched)."""
+    from fedml_tpu.comm.chaos import chaos_enabled
+
+    if not (getattr(config, "wire_reliable", False) or chaos_enabled(config)):
+        return None
+    return lambda rank, comm: build_wire_stack(comm, config, rank)

@@ -97,6 +97,25 @@ class FedConfig:
     # wire_codec="raw"; pairs with "topk:<r>"/"q8", whose un-sent mass
     # re-enters the next round's upload.
     wire_delta: bool = False
+    # Reliable wire delivery (comm/reliable.py): per-pair sequence numbers,
+    # ACK/retransmit with exponential backoff, receiver-side dedup — every
+    # protocol handler sees exact-once semantics over a lossy wire. With
+    # zero faults the layer is bit-identical to the bare transports
+    # (tests/test_chaos.py), so the only cost of enabling it is the ack
+    # traffic. Required whenever chaos drop/dup/reorder rates are set.
+    wire_reliable: bool = False
+    # Chaos injection (comm/chaos.py): seeded, deterministic wire faults for
+    # robustness testing. Rates are per-transmission probabilities; delay is
+    # the max per-message latency in ms (uniform draw). chaos_crash_rank /
+    # chaos_crash_after crash-stop one rank after that many sends (the
+    # killed-process model the straggler deadline handles).
+    chaos_seed: int = 0
+    chaos_drop: float = 0.0
+    chaos_dup: float = 0.0
+    chaos_delay_ms: float = 0.0
+    chaos_reorder: float = 0.0
+    chaos_crash_rank: Optional[int] = None
+    chaos_crash_after: Optional[int] = None
     frequency_of_the_test: int = 5
     is_mobile: int = 0
     seed: int = 0
@@ -237,6 +256,24 @@ class FedConfig:
                 raise ValueError(
                     f"rank {self.rank} out of range for world_size {self.world_size}"
                 )
+        for f_ in ("chaos_drop", "chaos_dup", "chaos_reorder"):
+            v = getattr(self, f_)
+            if not 0.0 <= v < 1.0:
+                raise ValueError(f"{f_} must be in [0, 1), got {v}")
+        if self.chaos_delay_ms < 0:
+            raise ValueError(
+                f"chaos_delay_ms must be >= 0, got {self.chaos_delay_ms}")
+        if (self.chaos_drop or self.chaos_dup or self.chaos_reorder) \
+                and not self.wire_reliable:
+            raise ValueError(
+                "chaos drop/dup/reorder need wire_reliable=True: without the "
+                "reliable layer a dropped message hangs the message-counting "
+                "barriers and a duplicated upload double-aggregates"
+            )
+        if (self.chaos_crash_rank is None) != (self.chaos_crash_after is None):
+            raise ValueError(
+                "chaos_crash_rank and chaos_crash_after must be set together"
+            )
         from fedml_tpu.core.compression import parse_codec
 
         parse_codec(self.wire_codec)   # raises on an unknown codec spec
@@ -343,6 +380,23 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--wire_delta", type=lambda s: bool(int(s)),
                    default=defaults.wire_delta,
                    help="edge FedAvg uploads error-feedback deltas (0|1)")
+    p.add_argument("--wire_reliable", type=lambda s: bool(int(s)),
+                   default=defaults.wire_reliable,
+                   help="ACK/retransmit + dedup wire layer (0|1)")
+    p.add_argument("--chaos_seed", type=int, default=defaults.chaos_seed)
+    p.add_argument("--chaos_drop", type=float, default=defaults.chaos_drop,
+                   help="P(drop) per transmission (needs --wire_reliable 1)")
+    p.add_argument("--chaos_dup", type=float, default=defaults.chaos_dup,
+                   help="P(duplicate) per transmission")
+    p.add_argument("--chaos_delay_ms", type=float,
+                   default=defaults.chaos_delay_ms,
+                   help="max per-message injected latency in ms")
+    p.add_argument("--chaos_reorder", type=float,
+                   default=defaults.chaos_reorder,
+                   help="P(hold a message until the next send overtakes it)")
+    p.add_argument("--chaos_crash_rank", type=int, default=None,
+                   help="crash-stop this rank after --chaos_crash_after sends")
+    p.add_argument("--chaos_crash_after", type=int, default=None)
     p.add_argument("--run_name", type=str, default=defaults.run_name)
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)

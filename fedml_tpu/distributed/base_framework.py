@@ -42,9 +42,9 @@ MAX_EMPTY_DEADLINES = 10
 
 
 def require_injectable(comm, feature: str = "straggler_deadline_sec") -> None:
-    from fedml_tpu.comm import BaseCommunicationManager
-
-    if type(comm).inject_local is BaseCommunicationManager.inject_local:
+    # asks the manager itself (not its type): wire middleware wrappers
+    # (reliable/chaos) delegate the answer to the transport they wrap
+    if not comm.supports_local_injection():
         raise ValueError(
             f"{feature} needs a transport with local event injection "
             f"(local/grpc); {type(comm).__name__} has none")

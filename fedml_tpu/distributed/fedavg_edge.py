@@ -87,6 +87,10 @@ class FedAVGAggregator:
         self.sample_num_dict: dict[int, float] = {}
         self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}
         self.test_history: list[dict] = []
+        # exact-once accounting: every accepted upload increments this, so a
+        # lossy-wire run can assert no upload was aggregated twice
+        # (uploads_accepted == rounds x workers under full participation)
+        self.uploads_accepted = 0
         self._eval = make_eval_fn(bundle, get_task(dataset.task, dataset.class_num)) if bundle is not None and dataset is not None else None
 
     def get_global_model_params(self):
@@ -96,6 +100,7 @@ class FedAVGAggregator:
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True
+        self.uploads_accepted += 1
 
     def check_whether_all_receive(self) -> bool:
         if not all(self.flag_client_model_uploaded_dict.values()):
@@ -159,6 +164,11 @@ class FedAvgEdgeServerManager(ServerManager):
             self._deadline_timer = RoundDeadlineTimer(
                 comm, self._deadline, rank, MSG_ARG_KEY_ROUND)
         self._alive = {w: True for w in range(size - 1)}
+        # uploads dropped as stale (wrong round tag / pre-re-deal gen): a
+        # RETRANSMITTED upload landing after its round was deadline-closed
+        # counts here, never in the aggregate — surfaced with the wire
+        # counters so a lossy run is diagnosable
+        self.stale_uploads = 0
         self._lost_clients: list[int] = []
         self._assignment_map: dict[int, list[int]] = {}
         self._expected: set[int] = set(range(size - 1))
@@ -417,9 +427,13 @@ class FedAvgEdgeServerManager(ServerManager):
                 self._alive[w] = True
             tag = msg.get(MSG_ARG_KEY_ROUND)
             if tag is not None and int(tag) != self.round_idx:
+                # late (possibly retransmitted) upload of a round that was
+                # already deadline-closed: stale, never double-aggregated
+                self.stale_uploads += 1
                 return
             gen = msg.get(MSG_ARG_KEY_GEN)
             if gen is not None and int(gen) != self._bcast_gen:
+                self.stale_uploads += 1
                 return   # pre-re-deal upload of the current round
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         if payload is None:
@@ -711,7 +725,7 @@ def build_edge_rank(dataset, config, rank: int, world_size: int, comm,
 
 
 def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = True,
-                    comm_factory=None):
+                    comm_factory=None, timeout: float = 300.0):
     """In-process launch: 1 server + worker_num clients over the local
     transport (the reference's mpirun path, FedAvgAPI.py:20-28) or a real
     transport via ``comm_factory`` (e.g. gRPC loopback). Returns the
@@ -730,9 +744,23 @@ def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = Tru
                                bundle=bundle, root_key=root_key,
                                aggregator=aggregator)
 
-    run_ranks(make, size, wire_roundtrip=wire_roundtrip,
-              comm_factory=comm_factory,
-              codec=getattr(config, "wire_codec", "raw"))
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+
+    managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip,
+                         comm_factory=comm_factory, timeout=timeout,
+                         codec=getattr(config, "wire_codec", "raw"),
+                         wrap=wire_wrap_factory(config))
+    from fedml_tpu.utils.metrics import merge_wire_stats
+
+    aggregator.wire_stats = merge_wire_stats(
+        [m.com_manager for m in managers])
+    aggregator.wire_stats["wire/stale_uploads"] = managers[0].stale_uploads
+    anomalies = ("wire/retransmits", "wire/retransmit_errors", "wire/gave_up",
+                 "wire/dup_dropped", "wire/stale_uploads")
+    if any(aggregator.wire_stats.get(k, 0) for k in anomalies) or any(
+            k.startswith("chaos/") and v
+            for k, v in aggregator.wire_stats.items()):
+        LOG.info("wire stats: %s", aggregator.wire_stats)
     return aggregator
 
 
@@ -768,8 +796,25 @@ def run_fedavg_edge_rank(dataset, config):
         send_timeout=deadline if deadline is not None and config.rank == 0
         else 120.0,
     )
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+
+    wrap = wire_wrap_factory(config)
+    if wrap is not None:
+        comm = wrap(config.rank, comm)
     manager = build_edge_rank(dataset, config, config.rank, config.world_size, comm)
     LOG.info("rank %d/%d entering run loop (grpc base port %d)",
              config.rank, config.world_size, config.grpc_base_port)
     manager.run()
-    return manager.aggregator if config.rank == 0 else None
+    from fedml_tpu.utils.metrics import wire_stats
+
+    stats = wire_stats(comm)
+    if stats:
+        # per-rank deployment: each process only sees its OWN comm stack, so
+        # every rank reports its counters — uplink loss shows up in worker
+        # logs, not in the server's (rank-0-only) wire_stats
+        LOG.info("rank %d wire stats: %s", config.rank, stats)
+    if config.rank != 0:
+        return None
+    manager.aggregator.wire_stats = stats
+    manager.aggregator.wire_stats["wire/stale_uploads"] = manager.stale_uploads
+    return manager.aggregator

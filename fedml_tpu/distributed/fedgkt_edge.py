@@ -529,7 +529,10 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
     # logits both ways); the wire codec compresses them — q8 suits the
     # distillation exchange, whose targets are soft logits anyway. Labels/
     # masks and any integer arrays ride raw inside lossy frames.
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+
     managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip,
                          comm_factory=comm_factory,
-                         codec=getattr(config, "wire_codec", "raw"))
+                         codec=getattr(config, "wire_codec", "raw"),
+                         wrap=wire_wrap_factory(config))
     return managers[0]

@@ -543,6 +543,8 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
                                         epochs_per_turn=config.epochs, turns=1,
                                         managed=deadline is not None)
 
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+
     run_ranks(make, size, wire_roundtrip=wire_roundtrip,
-              comm_factory=comm_factory)
+              comm_factory=comm_factory, wrap=wire_wrap_factory(config))
     return server_trainer

@@ -777,6 +777,8 @@ def run_turboaggregate_edge(dataset, config, group_size: int = 2,
         return TAEdgeClientManager(args, comm, rank, size, dataset, bundle,
                                    config, root_key, group_size, frac_bits)
 
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+
     run_ranks(make, size, wire_roundtrip=wire_roundtrip,
-              comm_factory=comm_factory)
+              comm_factory=comm_factory, wrap=wire_wrap_factory(config))
     return holder["server"]

@@ -45,6 +45,7 @@ MSG_TYPE_G2H_CKPT = "vfl_ckpt"         # guest -> host: persist party state now
 KEY_IDX = "idx"
 KEY_U = "u"
 KEY_STEP = "step"
+KEY_EPOCH = "epoch"
 
 
 class VFLHostManager(ClientManager):
@@ -62,6 +63,11 @@ class VFLHostManager(ClientManager):
         # (raw params never travel), so resume must restore it locally —
         # the GKT-client pattern (fedgkt_edge.py)
         self._state_path = state_path
+        # epoch this host's restored state belongs to; checked against the
+        # guest's resumed epoch on the first batch (ADVICE r5 low: a crash
+        # between the guest's save and a host's persist used to resume with
+        # guest params at epoch e and host params at e-1, undetectably)
+        self._resumed_epoch: "int | None" = None
         if resume and state_path is not None:
             import os
 
@@ -72,6 +78,8 @@ class VFLHostManager(ClientManager):
                     st = tree_from_bytes(f.read())
                 self.party.params = st["params"]
                 self.party.opt_state = st["opt"]
+                if "epoch" in st:
+                    self._resumed_epoch = int(np.asarray(st["epoch"]).item())
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(MSG_TYPE_G2H_BATCH, self._on_batch)
@@ -87,7 +95,8 @@ class VFLHostManager(ClientManager):
         from fedml_tpu.core.serialization import tree_to_bytes
 
         blob = tree_to_bytes({"params": self.party.params,
-                              "opt": self.party.opt_state})
+                              "opt": self.party.opt_state,
+                              "epoch": np.int64(msg.get(KEY_EPOCH, -1))})
         tmp = self._state_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
@@ -96,6 +105,18 @@ class VFLHostManager(ClientManager):
         os.replace(tmp, self._state_path)
 
     def _on_batch(self, msg: Message):
+        if self._resumed_epoch is not None:
+            guest_epoch = msg.get(KEY_EPOCH)
+            if guest_epoch is not None and int(guest_epoch) != self._resumed_epoch:
+                raise RuntimeError(
+                    f"VFL resume inconsistency: host rank {self.rank} restored "
+                    f"party state from epoch {self._resumed_epoch} but the "
+                    f"guest resumed at epoch {int(guest_epoch)} — the parties' "
+                    "checkpoints are from different training points (crash "
+                    "between guest save and host persist?); restore a "
+                    "matching set or restart from scratch"
+                )
+            self._resumed_epoch = None
         idx = np.asarray(msg.get(KEY_IDX), np.int64)
         self.party.set_batch(self.x_train[idx])
         out = Message(MSG_TYPE_H2G_COMPONENT, self.rank, 0)
@@ -179,6 +200,7 @@ class VFLGuestManager(ServerManager):
         for rank in range(1, self.size):
             m = Message(MSG_TYPE_G2H_BATCH, self.rank, rank)
             m.add_params(KEY_STEP, self.step)
+            m.add_params(KEY_EPOCH, self.epoch)
             m.add_params(KEY_IDX, idx.astype(np.int64))
             self.send_message(m)
 
@@ -221,7 +243,11 @@ class VFLGuestManager(ServerManager):
         from fedml_tpu.utils.checkpoint import save_checkpoint
 
         for rank in range(1, self.size):
-            self.send_message(Message(MSG_TYPE_G2H_CKPT, self.rank, rank))
+            m = Message(MSG_TYPE_G2H_CKPT, self.rank, rank)
+            # the epoch tag makes the cross-party checkpoint SET verifiable:
+            # every host .state file records which guest epoch it pairs with
+            m.add_params(KEY_EPOCH, self.epoch)
+            self.send_message(m)
         save_checkpoint(self._ckpt_path,
                         {"params": self.party.params,
                          "opt": self.party.opt_state},

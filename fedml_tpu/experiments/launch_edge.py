@@ -13,6 +13,12 @@ so the exact same per-rank entry deploys across machines — run it by hand
 (reference grpc_ipconfig.csv, grpc_comm_manager.py:59-60). This helper just
 automates the single-host case. See docs/deploy.md for the runbook.
 
+All FedConfig flags pass through to every rank — including the wire
+reliability/chaos knobs (--wire_reliable, --chaos_seed, --chaos_drop,
+--chaos_dup, --chaos_delay_ms, --chaos_reorder, --chaos_crash_rank,
+--chaos_crash_after; docs/deploy.md "Wire reliability"), so a lossy-wire
+rehearsal runs with the exact deployment entry points.
+
 Usage:
     python -m fedml_tpu.experiments.launch_edge --world_size 3 \
         --dataset synthetic_1_1 --model lr --comm_round 5 [flags...]

@@ -110,6 +110,40 @@ class MetricsLogger:
             self._wandb.finish()
 
 
+def wire_stats(comm) -> dict:
+    """Flatten the retry/dedup/drop counters of a wire middleware stack
+    (comm/reliable.py over comm/chaos.py over a bare transport) into
+    wandb-style keys — ``wire/retransmits``, ``wire/dup_dropped``,
+    ``chaos/dropped``, ... — so a lossy run is diagnosable from the same
+    metrics surface as everything else. A bare transport (no wrappers)
+    yields {}; counters are read without locks (monotonic ints, summary
+    use only)."""
+    from fedml_tpu.comm.chaos import ChaosCommManager
+    from fedml_tpu.comm.reliable import ReliableCommManager
+
+    out: dict = {}
+    node = comm
+    while node is not None:
+        prefix = ("wire" if isinstance(node, ReliableCommManager)
+                  else "chaos" if isinstance(node, ChaosCommManager)
+                  else None)
+        if prefix is not None:
+            for k, v in getattr(node, "stats", {}).items():
+                key = f"{prefix}/{k}"
+                out[key] = out.get(key, 0) + v
+        node = getattr(node, "inner", None)
+    return out
+
+
+def merge_wire_stats(comms) -> dict:
+    """Sum wire_stats across a federation's managers (one entry per rank)."""
+    total: dict = {}
+    for c in comms:
+        for k, v in wire_stats(c).items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
 def notify_sweep_complete(pipe_path: Optional[str] = None) -> bool:
     """Signal an external sweep orchestrator that this run finished.
 

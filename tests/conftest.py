@@ -22,3 +22,13 @@ import jax  # noqa: E402
 # undo — counter-update so unit tests stay on the virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running (excluded from the tier-1 gate)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded wire-fault injection (comm/chaos.py); small enough "
+        "to stay inside the tier-1 time budget — tools/chaos_sweep.py runs "
+        "the wide multi-seed version")

@@ -1,0 +1,373 @@
+"""Reliable wire delivery under seeded chaos injection.
+
+The edge transports are fire-and-forget; every protocol advances rounds by
+message counting, so the wire layer (comm/reliable.py) must turn a lossy
+wire into exact-once handler semantics. These tests pin:
+
+- zero faults injected -> the reliable layer is bit-identical to today's
+  strict path (same history, same final weights);
+- seeded drop/dup/reorder at the acceptance rates (20%/10%/10%) -> a full
+  FedAvg-edge federation completes every round on all three transports and
+  the server aggregates each upload exactly once (retry/dedup counters);
+- a retransmitted upload landing after its round was deadline-closed is
+  dropped as stale, never double-aggregated;
+- a chaos crash-stopped rank is absorbed by the straggler-deadline
+  machinery exactly like a killed process.
+
+Marked ``chaos``: small enough for the tier-1 budget; tools/chaos_sweep.py
+runs the wide multi-seed version.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.comm import Message
+from fedml_tpu.comm.chaos import ChaosCommManager
+from fedml_tpu.comm.local import LocalCommunicationManager, LocalRouter
+from fedml_tpu.comm.reliable import ReliableCommManager
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data import load_dataset
+from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+
+pytestmark = pytest.mark.chaos
+
+WORKERS = 3
+ROUNDS = 3
+
+# acceptance-criteria fault rates
+CHAOS = dict(wire_reliable=True, chaos_drop=0.2, chaos_dup=0.1,
+             chaos_reorder=0.1, chaos_seed=7)
+
+
+def _cfg(**kw):
+    base = dict(
+        model="lr", dataset="synthetic_1_1", client_num_in_total=6,
+        client_num_per_round=6, comm_round=ROUNDS, batch_size=10, lr=0.1,
+        epochs=1, frequency_of_the_test=1, seed=5, device_data="off",
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _ds():
+    return load_dataset("synthetic_1_1", num_clients=6, batch_size=10, seed=5)
+
+
+def _history(agg):
+    return ([h["round"] for h in agg.test_history],
+            [h["acc"] for h in agg.test_history],
+            [h["loss"] for h in agg.test_history])
+
+
+@pytest.fixture(scope="module")
+def strict_run():
+    """Today's bare-transport run: the reference every wire variant must
+    reproduce bit-identically (content-wise) on zero injected faults."""
+    return run_fedavg_edge(_ds(), _cfg(), worker_num=WORKERS)
+
+
+# -- reliable layer alone: bit-identical to the strict path ----------------
+
+def test_reliable_zero_faults_bit_identical(strict_run):
+    rel = run_fedavg_edge(_ds(), _cfg(wire_reliable=True), worker_num=WORKERS)
+    assert _history(rel) == _history(strict_run)
+    for a, b in zip(jax.tree.leaves(strict_run.variables),
+                    jax.tree.leaves(rel.variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # clean wire: acks flowed, nothing was lost. (A spurious retransmit —
+    # an ack outrun by the backoff timer under scheduler load — is benign:
+    # dedup absorbs it without touching results, so it is not asserted away.)
+    assert rel.wire_stats["wire/gave_up"] == 0
+    assert rel.wire_stats.get("chaos/dropped", 0) == 0
+    assert rel.wire_stats["wire/acks_sent"] > 0
+
+
+# -- chaos at acceptance rates: completes, exact-once, same result ---------
+
+def test_chaos_local_completes_exact_once(strict_run):
+    agg = run_fedavg_edge(_ds(), _cfg(**CHAOS), worker_num=WORKERS)
+    # every round closed, in order
+    assert [h["round"] for h in agg.test_history] == list(range(ROUNDS))
+    # exact-once: each of the rounds x workers uploads aggregated once —
+    # duplicates were eaten by dedup, drops were recovered by retransmit
+    assert agg.uploads_accepted == ROUNDS * WORKERS
+    assert agg.wire_stats["wire/retransmits"] > 0
+    assert agg.wire_stats["chaos/dropped"] > 0
+    assert agg.wire_stats["wire/dup_dropped"] > 0
+    # and the lossy-wire run converges to the strict run EXACTLY: delivery
+    # faults may reorder arrivals, but aggregation is order-independent
+    assert _history(agg) == _history(strict_run)
+    for a, b in zip(jax.tree.leaves(strict_run.variables),
+                    jax.tree.leaves(agg.variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_chaos_grpc_completes_exact_once():
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    agg = run_fedavg_edge(
+        _ds(), _cfg(**CHAOS), worker_num=WORKERS,
+        comm_factory=lambda r: GRPCCommManager(
+            rank=r, size=WORKERS + 1, base_port=56930, host="127.0.0.1"))
+    assert [h["round"] for h in agg.test_history] == list(range(ROUNDS))
+    assert agg.uploads_accepted == ROUNDS * WORKERS
+    assert agg.wire_stats["wire/retransmits"] > 0
+    assert all(np.isfinite(h["loss"]) for h in agg.test_history)
+
+
+def test_chaos_mqtt_completes_exact_once():
+    import fedml_tpu.comm.mqtt_backend as mqtt_backend
+    import fedml_tpu.comm.mqtt_broker as mb
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+
+    ds = make_synthetic_classification(
+        "chaos-mqtt", (8,), 3, 2, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=1)
+    cfg = FedConfig(model="lr", dataset="synthetic", client_num_in_total=2,
+                    client_num_per_round=2, comm_round=2, epochs=1,
+                    batch_size=4, lr=0.1, seed=0, frequency_of_the_test=1,
+                    device_data="off", **CHAOS)
+    with mb.MqttBroker(0) as broker:
+        agg = run_fedavg_edge(
+            ds, cfg, worker_num=2,
+            comm_factory=lambda r: mqtt_backend.MqttCommManager(
+                "127.0.0.1", broker.port, client_id=r, client_num=2))
+    assert [h["round"] for h in agg.test_history] == [0, 1]
+    assert agg.uploads_accepted == 2 * 2
+
+
+# -- deadline interaction: late retransmits are stale, not double-counted --
+
+def test_retransmitted_upload_after_deadline_close_is_stale():
+    from fedml_tpu.core.rng import seed_everything
+    from fedml_tpu.distributed.fedavg_edge import (
+        MSG_ARG_KEY_GEN,
+        MSG_ARG_KEY_MODEL_PARAMS,
+        MSG_ARG_KEY_NUM_SAMPLES,
+        MSG_ARG_KEY_ROUND,
+        MSG_TYPE_C2S_SEND_MODEL,
+        FedAVGAggregator,
+        FedAvgEdgeServerManager,
+        _edge_args,
+    )
+    from fedml_tpu.models import create_model
+
+    ds = _ds()
+    # no eval machinery: this test drives the handler surface directly
+    cfg = _cfg(straggler_deadline_sec=30.0, frequency_of_the_test=10_000)
+
+    sent = []
+
+    class _Comm:
+        def add_observer(self, o):
+            pass
+
+        def send_message(self, m):
+            sent.append(m)
+
+        def inject_local(self, m):
+            pass
+
+        def supports_local_injection(self):
+            return True
+
+        def stop_receive_message(self):
+            pass
+
+    bundle = create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:])
+    root = seed_everything(cfg.seed)
+    agg = FedAVGAggregator(bundle.init(root), 2, cfg, dataset=ds, bundle=bundle)
+    server = FedAvgEdgeServerManager(_edge_args(cfg, ds), _Comm(), 0, 3, agg)
+    server._assignment_map = server._assignments(0)
+    server._broadcast_model(2, agg.get_global_model_params(),
+                            server._assignment_map)
+
+    def upload(worker, round_tag):
+        m = Message(MSG_TYPE_C2S_SEND_MODEL, worker + 1, 0)
+        m.add_params(MSG_ARG_KEY_ROUND, round_tag)
+        m.add_params(MSG_ARG_KEY_GEN, server._bcast_gen)
+        m.add_params(MSG_ARG_KEY_MODEL_PARAMS, bundle.init(root))
+        m.add_params(MSG_ARG_KEY_NUM_SAMPLES, 10.0)
+        return m
+
+    # worker 0 uploads in time; worker 1 misses the deadline
+    server.handle_message_receive_model_from_client(upload(0, 0))
+    assert agg.uploads_accepted == 1
+    deadline = Message(99, 0, 0)
+    deadline.add_params(MSG_ARG_KEY_ROUND, 0)
+    server.handle_round_deadline(deadline)
+    assert server.round_idx == 1 and not server._alive[1]
+
+    # worker 1's retransmitted round-0 upload lands AFTER the close: it must
+    # be dropped as stale — not aggregated into round 1
+    server.handle_message_receive_model_from_client(upload(1, 0))
+    assert server.stale_uploads == 1
+    assert agg.uploads_accepted == 1
+    assert 1 not in agg.model_dict
+    server._cancel_timer()
+
+
+def test_chaos_crash_stop_absorbed_by_deadline():
+    """chaos_crash_rank kills a worker mid-federation (silent in both
+    directions, receive loop exits — the in-process kill -9); the deadline
+    marks it dead, survivors re-deal its clients, every round closes."""
+    ds = _ds()
+    cfg = _cfg(straggler_deadline_sec=8.0, comm_round=4,
+               chaos_crash_rank=2, chaos_crash_after=3, chaos_seed=1)
+    agg = run_fedavg_edge(ds, cfg, worker_num=WORKERS)
+    assert [h["round"] for h in agg.test_history] == list(range(4))
+    assert all(np.isfinite(h["loss"]) for h in agg.test_history)
+    assert agg.wire_stats["chaos/crash_stops"] == 1
+
+
+# -- reliable layer unit behavior ------------------------------------------
+
+def _reliable_pair(drop=0.0, dup=0.0, reorder=0.0, delay_ms=0.0, seed=0,
+                   chaos=True):
+    router = LocalRouter(2)
+    comms = []
+    for r in range(2):
+        c = LocalCommunicationManager(router, r, wire_roundtrip=True)
+        if chaos:
+            c = ChaosCommManager(c, drop=drop, dup=dup, reorder=reorder,
+                                 delay_ms=delay_ms, seed=seed, rank=r)
+        comms.append(ReliableCommManager(c, rank=r, retry_base_s=0.01,
+                                         retry_cap_s=0.1, retry_max=14))
+    return comms
+
+
+def _drive_pair(comms, n, timeout=30.0):
+    """Send n payloads 0..n-1 from rank 0 to rank 1; both receive loops run
+    (rank 0's processes the acks). Returns the payloads rank 1's handler
+    observed, in arrival order."""
+    got = []
+    done = threading.Event()
+
+    class Sink:
+        def receive_message(self, t, m):
+            got.append(int(m.get("i")))
+            if len(got) >= n:
+                done.set()
+
+    comms[1].add_observer(Sink())
+    threads = [threading.Thread(target=c.handle_receive_message, daemon=True)
+               for c in comms]
+    for t in threads:
+        t.start()
+    for i in range(n):
+        m = Message("data", 0, 1)
+        m.add_params("i", i)
+        comms[0].send_message(m)
+    done.wait(timeout)
+    # settle so straggling duplicates get counted before assertions
+    time.sleep(0.3)
+    for c in comms:
+        c.stop_receive_message()
+    return got
+
+
+def test_reliable_recovers_drops_exactly_once():
+    comms = _reliable_pair(drop=0.3, seed=3)
+    got = _drive_pair(comms, 40)
+    assert sorted(got) == list(range(40))          # nothing lost...
+    assert len(got) == 40                          # ...nothing delivered twice
+    assert comms[0].stats["retransmits"] > 0
+    assert comms[0].stats["gave_up"] == 0
+
+
+def test_reliable_dedups_duplicates():
+    comms = _reliable_pair(dup=0.5, seed=4)
+    got = _drive_pair(comms, 40)
+    assert sorted(got) == list(range(40))
+    assert len(got) == 40
+    assert comms[1].stats["dup_dropped"] > 0
+
+
+def test_reliable_survives_drop_dup_reorder_delay_together():
+    comms = _reliable_pair(drop=0.2, dup=0.2, reorder=0.2, delay_ms=20,
+                           seed=5)
+    got = _drive_pair(comms, 40)
+    assert sorted(got) == list(range(40))
+    assert len(got) == 40
+
+
+def test_chaos_fates_are_seed_deterministic():
+    """The fate of (message, attempt) is a pure function of the seed: two
+    wrapper instances with the same seed eat exactly the same copies."""
+
+    class _Null:
+        codec = "raw"
+        sent = None
+
+        def __init__(self):
+            self.sent = []
+
+        def add_observer(self, o):
+            pass
+
+        def send_message(self, m):
+            self.sent.append(int(m.get("i")))
+
+    from fedml_tpu.comm.message import MSG_ARG_KEY_WIRE_SEQ
+
+    def run(seed):
+        inner = _Null()
+        chaos = ChaosCommManager(inner, drop=0.4, seed=seed, rank=1)
+        for i in range(60):
+            m = Message("d", 1, 0)
+            m.add_params("i", i)
+            m.add_params(MSG_ARG_KEY_WIRE_SEQ, i)
+            chaos.send_message(m)
+        return inner.sent
+
+    a, b, c = run(11), run(11), run(12)
+    assert a == b                 # same seed -> identical fates
+    assert a != c                 # different seed -> different fates
+    assert 0 < len(a) < 60        # drop=0.4 actually dropped some
+
+
+def test_restarted_sender_incarnation_not_deduped():
+    """A restarted rank restarts its seq stream at 0; dedup keys on
+    (sender, incarnation), so the new incarnation's messages — crucial for
+    the JOIN/rejoin path — must NOT be swallowed as duplicates of the old
+    one's window."""
+    router = LocalRouter(2)
+    recv = ReliableCommManager(
+        LocalCommunicationManager(router, 1, wire_roundtrip=True), rank=1)
+    got = []
+
+    class Sink:
+        def receive_message(self, t, m):
+            got.append(int(m.get("i")))
+
+    recv.add_observer(Sink())
+    t = threading.Thread(target=recv.handle_receive_message, daemon=True)
+    t.start()
+    for incarnation in range(2):   # original rank 0, then its restart
+        sender = ReliableCommManager(
+            LocalCommunicationManager(router, 0, wire_roundtrip=True), rank=0)
+        m = Message("data", 0, 1)
+        m.add_params("i", incarnation)
+        sender.send_message(m)     # both stamped seq=0
+        sender.stop_receive_message()
+    deadline = time.monotonic() + 10
+    while len(got) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    recv.stop_receive_message()
+    assert got == [0, 1]
+    assert recv.stats["dup_dropped"] == 0
+
+
+def test_chaos_requires_reliable_layer():
+    with pytest.raises(ValueError):
+        _cfg(chaos_drop=0.2)
+    with pytest.raises(ValueError):
+        _cfg(wire_reliable=True, chaos_drop=1.5)
+    with pytest.raises(ValueError):
+        _cfg(chaos_crash_rank=1)   # crash_after missing

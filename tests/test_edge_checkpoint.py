@@ -115,3 +115,36 @@ def test_stale_residual_is_discarded(tmp_path):
     # run completes sanely (the discarded residual only perturbs the
     # compression error stream, not correctness)
     assert [h["round"] for h in resumed.test_history] == list(range(ROUNDS))
+
+
+def test_vfl_host_epoch_mismatch_fails_loudly(tmp_path):
+    """ADVICE r5 low: host .state files now carry the guest epoch they pair
+    with; a resume whose host state is from a different epoch than the
+    guest checkpoint (crash between the guest save and a host persist) must
+    fail loudly instead of silently training with torn cross-party state."""
+    import numpy as np
+
+    from fedml_tpu.core.serialization import tree_from_bytes, tree_to_bytes
+    from fedml_tpu.data.vertical import make_synthetic_vertical
+    from fedml_tpu.distributed.vfl_edge import run_vfl_edge
+
+    ds = make_synthetic_vertical((4, 3), n_train=64, n_test=32, seed=0)
+    ckpt_dir = str(tmp_path / "vfl")
+    run_vfl_edge(ds, epochs=2, batch_size=16, seed=1,
+                 checkpoint_dir=ckpt_dir)
+    state_path = os.path.join(ckpt_dir, "vfl_host_1.state")
+    assert os.path.exists(state_path)
+    with open(state_path, "rb") as f:
+        st = tree_from_bytes(f.read())
+    # host .state records which guest epoch it belongs to
+    assert int(np.asarray(st["epoch"]).item()) == 2
+    # tear the pair: host state claims a different epoch than the guest ckpt
+    st["epoch"] = np.int64(1)
+    with open(state_path, "wb") as f:
+        f.write(tree_to_bytes(st))
+
+    with pytest.raises(RuntimeError) as excinfo:
+        run_vfl_edge(ds, epochs=4, batch_size=16, seed=1,
+                     checkpoint_dir=ckpt_dir, resume=True)
+    # run_ranks wraps the host's failure; the cause carries the real story
+    assert "resume inconsistency" in str(excinfo.value.__cause__)

@@ -1,0 +1,117 @@
+"""Multi-seed chaos sweep over the reliable edge wire.
+
+Runs a small FedAvg-edge federation (local transport) under seeded wire
+faults for N different chaos seeds and verifies, for every seed, that
+
+- the federation COMPLETES every round (a hang surfaces as run_ranks'
+  thread-join TimeoutError, reported as a failure — the process never
+  wedges);
+- the server aggregated each upload exactly once
+  (uploads_accepted == rounds x workers);
+- the final history is bit-identical to the strict no-fault baseline
+  (delivery faults may reorder arrivals; they must never change results).
+
+Exit status is non-zero if ANY seed hangs or mismatches, so this slots
+straight into CI. The per-seed fault draws are deterministic
+(comm/chaos.py), so a failing seed replays exactly.
+
+Usage: python tools/chaos_sweep.py [out.json] [--seeds N] [--drop P]
+                                   [--dup P] [--reorder P] [--delay_ms D]
+                                   [--rounds R] [--timeout S]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _arg(argv, flag, default, cast=float):
+    if flag in argv:
+        return cast(argv[argv.index(flag) + 1])
+    return default
+
+
+def main(argv):
+    out_path = argv[0] if argv and not argv[0].startswith("-") else None
+    seeds = _arg(argv, "--seeds", 5, int)
+    drop = _arg(argv, "--drop", 0.2)
+    dup = _arg(argv, "--dup", 0.1)
+    reorder = _arg(argv, "--reorder", 0.1)
+    delay_ms = _arg(argv, "--delay_ms", 0.0)
+    rounds = _arg(argv, "--rounds", 3, int)
+    timeout = _arg(argv, "--timeout", 120.0)
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data import load_dataset
+    from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+
+    workers = 3
+
+    def cfg(**kw):
+        return FedConfig(
+            model="lr", dataset="synthetic_1_1", client_num_in_total=6,
+            client_num_per_round=6, comm_round=rounds, batch_size=10,
+            lr=0.1, epochs=1, frequency_of_the_test=1, seed=5,
+            device_data="off", **kw)
+
+    def history(agg):
+        return [(h["round"], float(h["acc"]), float(h["loss"]))
+                for h in agg.test_history]
+
+    ds = load_dataset("synthetic_1_1", num_clients=6, batch_size=10, seed=5)
+    baseline = history(run_fedavg_edge(ds, cfg(), worker_num=workers))
+
+    results, failed = [], 0
+    for chaos_seed in range(seeds):
+        rec = {"chaos_seed": chaos_seed, "ok": False}
+        try:
+            agg = run_fedavg_edge(
+                ds,
+                cfg(wire_reliable=True, chaos_seed=chaos_seed,
+                    chaos_drop=drop, chaos_dup=dup, chaos_reorder=reorder,
+                    chaos_delay_ms=delay_ms),
+                worker_num=workers, timeout=timeout)
+        except Exception as e:   # TimeoutError == hang; anything else == crash
+            rec["error"] = f"{type(e).__name__}: {e}"
+            failed += 1
+            results.append(rec)
+            print(f"seed {chaos_seed}: FAIL ({rec['error']})", file=sys.stderr)
+            continue
+        rec["wire_stats"] = {k: int(v) for k, v in agg.wire_stats.items()}
+        rec["uploads_accepted"] = agg.uploads_accepted
+        if agg.uploads_accepted != rounds * workers:
+            rec["error"] = (f"exact-once violated: {agg.uploads_accepted} "
+                            f"uploads aggregated, expected {rounds * workers}")
+        elif history(agg) != baseline:
+            rec["error"] = "history mismatch vs strict no-fault baseline"
+        else:
+            rec["ok"] = True
+        if not rec["ok"]:
+            failed += 1
+            print(f"seed {chaos_seed}: FAIL ({rec['error']})", file=sys.stderr)
+        else:
+            print(f"seed {chaos_seed}: ok "
+                  f"(retransmits={rec['wire_stats'].get('wire/retransmits', 0)}, "
+                  f"dup_dropped={rec['wire_stats'].get('wire/dup_dropped', 0)})")
+        results.append(rec)
+
+    summary = {
+        "seeds": seeds, "failed": failed,
+        "rates": {"drop": drop, "dup": dup, "reorder": reorder,
+                  "delay_ms": delay_ms},
+        "rounds": rounds, "workers": workers,
+        "results": results,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps({"seeds": seeds, "failed": failed}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
