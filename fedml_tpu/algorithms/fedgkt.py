@@ -40,7 +40,7 @@ from fedml_tpu.core.config import FedConfig
 from fedml_tpu.core.rng import round_key, seed_everything
 from fedml_tpu.core.tasks import int_cross_entropy
 from fedml_tpu.data import FedDataset
-from fedml_tpu.models.gkt import GKTPair, create_gkt_pair
+from fedml_tpu.models.gkt import GKTPair, create_gkt_pair, gkt_blocks_from_names
 
 log = logging.getLogger(__name__)
 
@@ -83,10 +83,21 @@ class FedGKTAPI:
         dataset: FedDataset,
         config: FedConfig,
         pair: Optional[GKTPair] = None,
-        client_blocks: int = 3,
-        server_blocks_per_stage: int = 9,
+        client_blocks: Optional[int] = None,
+        server_blocks_per_stage: Optional[int] = None,
         server_mesh=None,
     ):
+        # None -> honor the reference's --model_client/--model_server names
+        # (resnet8 / resnet56_server by default, i.e. 3 and 9 blocks).
+        # Derived lazily: explicit block counts must keep working for model
+        # names the depth parser cannot read.
+        if client_blocks is None or server_blocks_per_stage is None:
+            derived = gkt_blocks_from_names(
+                config.model_client, config.model_server)
+            if client_blocks is None:
+                client_blocks = derived[0]
+            if server_blocks_per_stage is None:
+                server_blocks_per_stage = derived[1]
         self.dataset = dataset
         self.config = config
         # optional ('batch',) mesh for the server phase — the TPU counterpart

@@ -36,6 +36,25 @@ log = logging.getLogger(__name__)
 P_DEFAULT = np.int64(2**31 - 1)
 
 
+def _require_rng(rng) -> np.random.Generator:
+    """Every masking/share draw must come from a caller-seeded generator.
+
+    The OS-entropy fallback (``default_rng()`` with no seed) these helpers
+    used to carry made the shares — and any bug involving them —
+    irreproducible across runs (fedlint seeded-rng). Accepts a Generator,
+    or a seed (int / sequence) to derive one from.
+    """
+    if rng is None:
+        raise ValueError(
+            "rng is required: pass a np.random.Generator derived from the "
+            "run seed (or the seed itself) — OS-entropy shares break run "
+            "determinism"
+        )
+    if isinstance(rng, np.random.Generator):
+        return rng
+    return np.random.default_rng(rng)
+
+
 # ---------------------------------------------------------------- field ops
 
 def modpow(base: np.ndarray, exp: int, p: np.int64) -> np.ndarray:
@@ -86,7 +105,7 @@ def bgw_encode(
 ) -> np.ndarray:
     """Shamir/BGW: degree-T polynomial with constant term X evaluated at
     alpha_1..alpha_N (mpc_function.py:62-76). X [m, d] -> shares [N, m, d]."""
-    rng = rng or np.random.default_rng()
+    rng = _require_rng(rng)
     X = np.mod(np.asarray(X, np.int64), p)
     coeffs = rng.integers(0, int(p), size=(T + 1,) + X.shape, dtype=np.int64)
     coeffs[0] = X
@@ -143,7 +162,7 @@ def lcc_encode(
     """Split X [m, d] into K chunks + T random chunks, interpolate through
     them, evaluate at N points (mpc_function.py:111-134). Returns
     [N, m//K, d]."""
-    rng = rng or np.random.default_rng()
+    rng = _require_rng(rng)
     X = np.mod(np.asarray(X, np.int64), p)
     m = X.shape[0]
     assert m % K == 0, "rows must divide evenly into K chunks"
@@ -187,7 +206,7 @@ def additive_shares(
     rng: Optional[np.random.Generator] = None,
 ) -> np.ndarray:
     """n_out shares summing to x mod p (mpc_function.py:216-226)."""
-    rng = rng or np.random.default_rng()
+    rng = _require_rng(rng)
     x = np.mod(np.asarray(x, np.int64), p)
     shares = rng.integers(0, int(p), size=(n_out - 1,) + x.shape, dtype=np.int64)
     last = np.mod(x - np.sum(np.mod(shares, p), axis=0), p)

@@ -1,0 +1,28 @@
+"""fedlint — static analysis of the fedml_tpu correctness contract.
+
+The framework's implicit invariants (functions entering ``jax.jit`` /
+``shard_map`` must be pure and retrace-stable, every RNG must be
+seed-derived, every ``MSG_TYPE_*`` must have a handler, every config flag
+must be read) are machine-checked here on every test run. Pure stdlib —
+the analyzer parses the package with ``ast`` and never imports the code it
+checks, so it runs in milliseconds and works on broken trees.
+
+Public surface:
+
+    run_lint(root)              -> LintResult (findings + suppressed)
+    Finding                     rule / path / line / message record
+    RULES                       rule-id -> one-line description
+
+Violations are suppressed in place with a trailing
+``# fedlint: disable=<rule>[,<rule>...]`` comment (same line, or a
+standalone comment on the line above). Naming an unknown rule in a
+suppression is itself an error (``bad-suppression``).
+
+CLI: ``python tools/fedlint.py [--format json] [paths...]``.
+Docs: docs/DESIGN.md, section "Static analysis (fedlint)".
+"""
+
+from fedml_tpu.analysis.findings import Finding, RULES
+from fedml_tpu.analysis.engine import LintResult, run_lint
+
+__all__ = ["Finding", "RULES", "LintResult", "run_lint"]

@@ -1,0 +1,235 @@
+"""Traced-call-graph construction.
+
+Roots are every function that enters the XLA tracer: decorated with or
+passed to ``jit`` / ``pjit`` / ``pmap`` / ``shard_map`` (bare or under
+``jax.`` / ``functools.partial`` spellings). The graph is then closed over
+intra-package references — a Name mentioned inside a traced body (called
+directly, or handed to ``vmap`` / ``lax.scan`` / ``value_and_grad``) is
+traced too, as is ``self.method(...)`` within the defining class and the
+nested functions a factory returns into a traced context.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from fedml_tpu.analysis.index import (
+    FunctionInfo,
+    ModuleInfo,
+    PackageIndex,
+    Resolver,
+    ScopeNode,
+    dotted_name,
+    resolve_dotted_head,
+    walk_excluding_nested,
+)
+
+TRACER_NAMES = {"jit", "pjit", "pmap", "shard_map"}
+
+
+class RootInfo:
+    """How a function entered tracing (for the retrace-hazard rule)."""
+
+    __slots__ = ("kind", "lineno", "has_static_args")
+
+    def __init__(self, kind: str, lineno: int, has_static_args: bool):
+        self.kind = kind          # jit | pjit | pmap | shard_map
+        self.lineno = lineno      # the jit call / decorator line
+        self.has_static_args = has_static_args
+
+
+def _tracer_kind(node: ast.AST, mod: ModuleInfo) -> Optional[str]:
+    """'jit'/'pjit'/... if this expression names a tracer entry point."""
+    dotted = dotted_name(node)
+    if dotted is None:
+        return None
+    real = resolve_dotted_head(mod, dotted)
+    tail = real.split(".")[-1]
+    if tail not in TRACER_NAMES:
+        return None
+    head = real.split(".")[0]
+    # accept bare names (fixtures, ``from jax import jit``) and anything
+    # rooted at jax/functools-resolved modules; reject obvious non-jax
+    # attributes like ``self.jit``
+    if head in ("self", "cls"):
+        return None
+    return tail
+
+
+def _static_kwargs(call: ast.Call) -> bool:
+    return any(
+        kw.arg in ("static_argnums", "static_argnames")
+        for kw in call.keywords
+    )
+
+
+class TracedGraph:
+    def __init__(self, pkg: PackageIndex):
+        self.pkg = pkg
+        self.resolver = Resolver(pkg)
+        #: every traced root -> how it was traced
+        self.roots: Dict[FunctionInfo, RootInfo] = {}
+        #: all functions reachable from roots (roots included)
+        self.reachable: Set[FunctionInfo] = set()
+        #: reachable function -> one root qualname (for messages)
+        self.root_of: Dict[FunctionInfo, str] = {}
+        self._find_roots()
+        self._close()
+
+    # ------------------------------------------------------------- roots
+    def _add_root(self, fn: FunctionInfo, info: RootInfo):
+        prev = self.roots.get(fn)
+        if prev is None or (info.has_static_args and not prev.has_static_args):
+            self.roots[fn] = info
+
+    def _mark_call_arg(self, mod, scopes, arg, info: RootInfo):
+        """``jit(<arg>)``: resolve the traced callable(s) behind <arg>."""
+        if isinstance(arg, ScopeNode):
+            fn = mod.by_node.get(id(arg))
+            if fn:
+                self._add_root(fn, info)
+            return
+        if isinstance(arg, ast.Name):
+            for fn in self.resolver.resolve(mod, scopes, arg.id):
+                self._add_root(fn, info)
+            return
+        if isinstance(arg, ast.Attribute):
+            # jit(self.method): the bound method is the traced program
+            d = dotted_name(arg)
+            if d and d.startswith("self.") and d.count(".") == 1:
+                for fn in self._self_methods(mod, scopes, d[5:]):
+                    self._add_root(fn, info)
+            return
+        if isinstance(arg, ast.Call):
+            # jit(make_fn(...)): the factory body runs at build time but the
+            # functions it returns are the traced program
+            fns: Set[FunctionInfo] = set()
+            if isinstance(arg.func, ast.Name):
+                for fac in self.resolver.resolve(mod, scopes, arg.func.id):
+                    fns |= self.resolver.returned_functions(fac)
+            elif isinstance(arg.func, ast.Attribute):
+                d = dotted_name(arg.func)
+                if d and d.startswith("self."):
+                    for fac in self._self_methods(mod, scopes, d[5:]):
+                        fns |= self.resolver.returned_functions(fac)
+            for fn in fns:
+                self._add_root(fn, info)
+
+    def _self_methods(self, mod, scopes, name) -> Set[FunctionInfo]:
+        """self.<name> resolved against every class whose scope encloses."""
+        out: Set[FunctionInfo] = set()
+        for scope in scopes:
+            fi = mod.by_node.get(id(scope))
+            if fi is not None and fi.cls:
+                hit = self._class_method(mod, fi.cls, name)
+                if hit is not None:
+                    out.add(hit)
+                break
+        return out
+
+    def _class_method(
+        self, mod: ModuleInfo, cls: str, name: str, _depth: int = 0
+    ) -> Optional[FunctionInfo]:
+        if _depth > 4:
+            return None
+        hit = mod.classes.get(cls, {}).get(name)
+        if hit is not None:
+            return hit
+        for base in mod.class_bases.get(cls, []):
+            # same-module base first, then intra-package imported base
+            if base in mod.classes:
+                hit = self._class_method(mod, base, name, _depth + 1)
+                if hit is not None:
+                    return hit
+            target = mod.imports.get(base)
+            if target is not None:
+                base_mod = self.pkg.by_modname.get(target[0])
+                if base_mod is not None and target[1] in base_mod.classes:
+                    hit = self._class_method(
+                        base_mod, target[1], name, _depth + 1)
+                    if hit is not None:
+                        return hit
+        return None
+
+    def _find_roots(self):
+        for mod in self.pkg.modules:
+            for fn in mod.functions:
+                node = fn.node
+                if isinstance(node, ast.Lambda):
+                    continue
+                for dec in node.decorator_list:
+                    kind = _tracer_kind(dec, mod)
+                    if kind:
+                        self._add_root(
+                            fn, RootInfo(kind, dec.lineno, False))
+                        continue
+                    if isinstance(dec, ast.Call):
+                        # @jit(...) or @partial(jit, static_argnums=...)
+                        kind = _tracer_kind(dec.func, mod)
+                        if kind:
+                            self._add_root(fn, RootInfo(
+                                kind, dec.lineno, _static_kwargs(dec)))
+                            continue
+                        d = dotted_name(dec.func)
+                        if d and resolve_dotted_head(mod, d).split(".")[-1] \
+                                == "partial" and dec.args:
+                            kind = _tracer_kind(dec.args[0], mod)
+                            if kind:
+                                self._add_root(fn, RootInfo(
+                                    kind, dec.lineno, _static_kwargs(dec)))
+            # calls: jit(f, ...) anywhere in the module
+            for fn_scope, call in _iter_calls(mod):
+                kind = _tracer_kind(call.func, mod)
+                if not kind or not call.args:
+                    continue
+                scopes = fn_scope.scope_chain() if fn_scope else []
+                self._mark_call_arg(
+                    mod, scopes, call.args[0],
+                    RootInfo(kind, call.lineno, _static_kwargs(call)))
+
+    # ----------------------------------------------------------- closure
+    def _close(self):
+        work: List[FunctionInfo] = list(self.roots)
+        for fn in work:
+            self.root_of[fn] = fn.qualname
+        while work:
+            fn = work.pop()
+            if fn in self.reachable:
+                continue
+            self.reachable.add(fn)
+            for nxt in self._edges(fn):
+                if nxt not in self.reachable:
+                    self.root_of.setdefault(nxt, self.root_of.get(
+                        fn, fn.qualname))
+                    work.append(nxt)
+
+    def _edges(self, fn: FunctionInfo) -> Set[FunctionInfo]:
+        mod, scopes = fn.module, fn.scope_chain()
+        out: Set[FunctionInfo] = set()
+        for node in walk_excluding_nested(fn.node):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                out |= self.resolver.resolve(mod, scopes, node.id)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                d = dotted_name(node)
+                if d and d.startswith("self.") and d.count(".") == 1 \
+                        and fn.cls:
+                    hit = self._class_method(mod, fn.cls, d[5:])
+                    if hit is not None:
+                        out.add(hit)
+        return out
+
+
+def _iter_calls(mod: ModuleInfo):
+    """(enclosing FunctionInfo | None, Call) for every call in the module."""
+    stack: List[tuple] = [(None, child) for child in
+                          ast.iter_child_nodes(mod.tree)]
+    while stack:
+        owner, node = stack.pop()
+        if isinstance(node, ScopeNode):
+            owner = mod.by_node.get(id(node), owner)
+        if isinstance(node, ast.Call):
+            yield owner, node
+        stack.extend(
+            (owner, child) for child in ast.iter_child_nodes(node))

@@ -1,0 +1,68 @@
+"""fedlint engine: parse a tree, run the rule catalog, apply suppressions."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set
+
+from fedml_tpu.analysis.callgraph import TracedGraph
+from fedml_tpu.analysis.findings import (
+    Finding,
+    RULES,
+    apply_suppressions,
+    parse_suppressions,
+)
+from fedml_tpu.analysis.index import load_package
+from fedml_tpu.analysis.rules import CHECKS
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]      # unsuppressed — these fail the gate
+    suppressed: List[Finding]    # silenced by # fedlint: disable=...
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+        }
+
+
+def run_lint(root: str, rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint every .py under ``root``.
+
+    ``rules`` restricts the catalog (default: all). Unknown rule names
+    raise ValueError so CI misconfigurations fail loudly.
+    """
+    selected = set(rules) if rules is not None else set(CHECKS)
+    unknown = selected - set(RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown fedlint rule(s): {', '.join(sorted(unknown))} "
+            f"(known: {', '.join(sorted(RULES))})"
+        )
+    pkg = load_package(root)
+    graph = TracedGraph(pkg)
+
+    findings: List[Finding] = []
+    for rule_id, check in CHECKS.items():
+        if rule_id in selected:
+            findings.extend(check(pkg, graph))
+
+    by_path: Dict[str, Dict[int, Set[str]]] = {}
+    for mod in pkg.modules:
+        lines, bad = parse_suppressions(mod.source, mod.relpath)
+        by_path[mod.relpath] = lines
+        if rules is None or "bad-suppression" in selected:
+            findings.extend(bad)
+
+    findings = sorted(
+        set(findings), key=lambda f: (f.path, f.line, f.rule, f.message)
+    )
+    kept, suppressed = apply_suppressions(findings, by_path)
+    return LintResult(kept, suppressed)

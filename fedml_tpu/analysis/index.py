@@ -1,0 +1,364 @@
+"""AST index of a Python package: modules, functions, scopes, imports.
+
+Everything downstream (traced-call-graph construction, the rule visitors)
+works off this index. It is deliberately import-free: modules are parsed
+with ``ast``, never executed, so the analyzer works on trees that do not
+import (broken deps, TPU-only modules) and costs milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+ScopeNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class FunctionInfo:
+    """One function (def or lambda) with enough context to resolve names."""
+
+    __slots__ = ("module", "name", "qualname", "node", "parent", "cls")
+
+    def __init__(self, module, name, qualname, node, parent, cls):
+        self.module: ModuleInfo = module
+        self.name: str = name
+        self.qualname: str = qualname
+        self.node = node
+        self.parent: Optional[FunctionInfo] = parent  # enclosing function
+        self.cls: Optional[str] = cls  # immediate enclosing class name
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<fn {self.module.modname}:{self.qualname}>"
+
+    def scope_chain(self) -> List[object]:
+        """Innermost-first list of enclosing scope nodes (self included)."""
+        chain, f = [], self
+        while f is not None:
+            chain.append(f.node)
+            f = f.parent
+        return chain
+
+
+class ModuleInfo:
+    def __init__(self, path: str, relpath: str, modname: str, source: str):
+        self.path = path
+        self.relpath = relpath  # path reported in findings
+        self.modname = modname  # dotted name used for import resolution
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        #: local name -> (module dotted path, original name | None for
+        #: ``import mod as name``)
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        self.functions: List[FunctionInfo] = []
+        self.by_node: Dict[int, FunctionInfo] = {}  # id(node) -> info
+        #: scope node id (0 = module) -> {name -> FunctionInfo}
+        self.scope_defs: Dict[int, Dict[str, FunctionInfo]] = {0: {}}
+        #: scope node id -> {name -> assigned value AST} (single-target
+        #: ``name = <expr>`` bindings, for factory-result resolution)
+        self.scope_binds: Dict[int, Dict[str, ast.AST]] = {0: {}}
+        #: class name -> {method name -> FunctionInfo}
+        self.classes: Dict[str, Dict[str, FunctionInfo]] = {}
+        #: class name -> base-class simple names (Name / Attribute tail)
+        self.class_bases: Dict[str, List[str]] = {}
+        #: module-level MSG_TYPE_* constants: name -> lineno
+        self.msg_constants: Dict[str, int] = {}
+        #: names listed in a module-level SEND_ONLY_MSG_TYPES collection
+        self.send_only: Set[str] = set()
+        _IndexVisitor(self).visit(self.tree)
+
+    def scope_id(self, scope_node) -> int:
+        return 0 if scope_node is None else id(scope_node)
+
+
+class _IndexVisitor(ast.NodeVisitor):
+    """Single pass filling every ModuleInfo table."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.scope_stack: List[Optional[FunctionInfo]] = [None]
+        self.class_stack: List[str] = []
+
+    # -- imports ----------------------------------------------------------
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            self.mod.imports[local] = (alias.name, None)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        base = node.module or ""
+        if node.level:  # relative import: resolve against this module
+            parts = self.mod.modname.split(".")
+            parts = parts[: len(parts) - node.level]
+            base = ".".join(parts + ([node.module] if node.module else []))
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            self.mod.imports[local] = (base, alias.name)
+
+    # -- defs / scopes -----------------------------------------------------
+    def _register_function(self, node, name: str) -> FunctionInfo:
+        parent = self.scope_stack[-1]
+        prefix = parent.qualname + "." if parent else ""
+        cls = self.class_stack[-1] if self.class_stack else None
+        info = FunctionInfo(self.mod, name, prefix + name, node, parent, cls)
+        self.mod.functions.append(info)
+        self.mod.by_node[id(node)] = info
+        if cls and parent is None:
+            # a method lives in its class namespace, not the module scope
+            self.mod.classes.setdefault(cls, {})[name] = info
+        else:
+            scope = self.mod.scope_id(parent.node if parent else None)
+            self.mod.scope_defs.setdefault(scope, {})[name] = info
+        return info
+
+    def _visit_function(self, node, name: str):
+        info = self._register_function(node, name)
+        self.mod.scope_defs.setdefault(id(node), {})
+        self.mod.scope_binds.setdefault(id(node), {})
+        self.scope_stack.append(info)
+        self.generic_visit(node)
+        self.scope_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_function(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_function(node, node.name)
+
+    def visit_Lambda(self, node):
+        self._visit_function(node, "<lambda>")
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        if not self.class_stack and self.scope_stack[-1] is None:
+            self.mod.classes.setdefault(node.name, {})
+            bases = []
+            for b in node.bases:
+                if isinstance(b, ast.Name):
+                    bases.append(b.id)
+                elif isinstance(b, ast.Attribute):
+                    bases.append(b.attr)
+            self.mod.class_bases[node.name] = bases
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    # -- assignments -------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        scope = self.mod.scope_id(
+            self.scope_stack[-1].node if self.scope_stack[-1] else None
+        )
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name) \
+                and not (self.class_stack and self.scope_stack[-1] is None):
+            name = node.targets[0].id
+            self.mod.scope_binds.setdefault(scope, {})[name] = node.value
+            if scope == 0:
+                if name.startswith("MSG_TYPE_"):
+                    self.mod.msg_constants[name] = node.lineno
+                elif name == "SEND_ONLY_MSG_TYPES":
+                    self.mod.send_only |= _collection_names(node.value)
+        self.generic_visit(node)
+
+
+def _collection_names(node: ast.AST) -> Set[str]:
+    """Names inside a literal set/tuple/list/frozenset(...) declaration."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "frozenset" and node.args:
+        node = node.args[0]
+    names: Set[str] = set()
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        for el in node.elts:
+            if isinstance(el, ast.Name):
+                names.add(el.id)
+    return names
+
+
+class PackageIndex:
+    def __init__(self, root: str, modules: List[ModuleInfo]):
+        self.root = root
+        self.modules = modules
+        self.by_modname: Dict[str, ModuleInfo] = {m.modname: m for m in modules}
+
+    def module_function(self, modname: str, fname: str) -> Optional[FunctionInfo]:
+        mod = self.by_modname.get(modname)
+        if mod is None:
+            return None
+        return mod.scope_defs.get(0, {}).get(fname)
+
+
+def iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in ("__pycache__", ".git") and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def load_package(root: str) -> PackageIndex:
+    """Parse every .py under ``root`` into a PackageIndex.
+
+    If ``root`` is itself a package (has __init__.py) its directory name
+    becomes the dotted-name prefix, so absolute intra-package imports
+    (``from fedml_tpu.x import y``) resolve. Fixture corpora without an
+    __init__.py get bare relative dotted names instead.
+    """
+    root = os.path.abspath(root)
+    pkg_prefix = (
+        os.path.basename(root)
+        if os.path.exists(os.path.join(root, "__init__.py"))
+        else None
+    )
+    modules = []
+    for path in iter_py_files(root):
+        rel = os.path.relpath(path, root)
+        parts = rel[:-3].replace(os.sep, "/").split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        if pkg_prefix:
+            parts = [pkg_prefix] + parts
+        modname = ".".join(parts) if parts else (pkg_prefix or "")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        report_path = os.path.join(
+            os.path.basename(root), rel
+        ) if pkg_prefix else rel
+        try:
+            modules.append(ModuleInfo(path, report_path, modname, source))
+        except SyntaxError as e:
+            raise SyntaxError(f"fedlint cannot parse {path}: {e}") from e
+    return PackageIndex(root, modules)
+
+
+class Resolver:
+    """Resolve a Name in a scope chain to the FunctionInfo set it can mean.
+
+    Lookup order is lexical: enclosing function scopes innermost-first,
+    then module top level, then intra-package imports. Assigned bindings
+    (``f = make_f(...)``) resolve through the factory's returned functions,
+    so closure calls like ``batch_step(...)`` inside a traced body reach
+    the nested def that actually runs.
+    """
+
+    def __init__(self, pkg: PackageIndex):
+        self.pkg = pkg
+        self._returns_cache: Dict[int, Set[FunctionInfo]] = {}
+
+    def resolve(
+        self, mod: ModuleInfo, scopes: List[object], name: str, _depth: int = 0
+    ) -> Set[FunctionInfo]:
+        if _depth > 6:
+            return set()
+        for scope in list(scopes) + [None]:
+            sid = mod.scope_id(scope)
+            hit = mod.scope_defs.get(sid, {}).get(name)
+            if hit is not None:
+                return {hit}
+            bound = mod.scope_binds.get(sid, {}).get(name)
+            if bound is not None:
+                return self._resolve_value(mod, scopes, bound, _depth + 1)
+        target = mod.imports.get(name)
+        if target is not None:
+            target_mod, orig = target
+            if orig is None:
+                return set()
+            hit2 = self.pkg.module_function(target_mod, orig)
+            if hit2 is not None:
+                return {hit2}
+        return set()
+
+    def _resolve_value(
+        self, mod: ModuleInfo, scopes: List[object], value: ast.AST, depth: int
+    ) -> Set[FunctionInfo]:
+        if isinstance(value, ast.Name):
+            return self.resolve(mod, scopes, value.id, depth)
+        if isinstance(value, ScopeNode):
+            info = mod.by_node.get(id(value))
+            return {info} if info else set()
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+            factories = self.resolve(mod, scopes, value.func.id, depth)
+            out: Set[FunctionInfo] = set()
+            for f in factories:
+                out |= self.returned_functions(f)
+            return out
+        return set()
+
+    def returned_functions(self, finfo: FunctionInfo) -> Set[FunctionInfo]:
+        """Functions a factory returns (``return fn`` / ``return f, g`` /
+        ``return jit(fn)`` / ``return Class(...)``-free best effort)."""
+        key = id(finfo.node)
+        if key in self._returns_cache:
+            return self._returns_cache[key]
+        self._returns_cache[key] = set()  # cycle guard
+        out: Set[FunctionInfo] = set()
+        scopes = finfo.scope_chain()
+        for stmt in walk_excluding_nested(finfo.node):
+            if not isinstance(stmt, ast.Return) or stmt.value is None:
+                continue
+            values = (
+                stmt.value.elts
+                if isinstance(stmt.value, ast.Tuple)
+                else [stmt.value]
+            )
+            for v in values:
+                if isinstance(v, ast.Call) and v.args:
+                    # return jax.jit(fn) / shard_map(fn, ...) etc: the
+                    # wrapped callable is what callers get
+                    out |= self._resolve_value(
+                        finfo.module, scopes, v.args[0], 1
+                    )
+                out |= self._resolve_value(finfo.module, scopes, v, 1)
+        self._returns_cache[key] = out
+        return out
+
+
+def walk_excluding_nested(func_node) -> Iterable[ast.AST]:
+    """Walk a function's own body, not the bodies of nested defs/lambdas.
+
+    Nested functions are separate call-graph nodes: they are only scanned
+    when reachability actually pulls them in (a nested helper that is never
+    referenced from traced code must not poison its parent).
+    """
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ScopeNode):
+            # still surface the nested def's decorators/defaults, which
+            # evaluate in the enclosing scope
+            if not isinstance(node, ast.Lambda):
+                stack.extend(node.decorator_list)
+                stack.extend(
+                    d for d in node.args.defaults + node.args.kw_defaults
+                    if d is not None
+                )
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_dotted_head(mod: ModuleInfo, dotted: str) -> str:
+    """Swap an import alias for its real module path: np.random.x ->
+    numpy.random.x; ``from numpy.random import default_rng`` -> same."""
+    head, _, rest = dotted.partition(".")
+    target = mod.imports.get(head)
+    if target is None:
+        return dotted
+    target_mod, orig = target
+    real_head = target_mod if orig is None else f"{target_mod}.{orig}"
+    return f"{real_head}.{rest}" if rest else real_head

@@ -33,7 +33,10 @@ MSG_ARG_KEY_WIRE_MID = "__wire_mid__"
 # its seq stream at 0, so dedup keys on (sender, incarnation) — otherwise a
 # rejoining worker's first messages would be swallowed as duplicates
 MSG_ARG_KEY_WIRE_INC = "__wire_inc__"
-MSG_TYPE_WIRE_ACK = "__wire_ack__"
+# ACKs are consumed inline by ReliableLayer (comm/reliable.py:220) before
+# dispatch, deliberately outside the handler registry — registering one
+# would deliver acks to application code.
+MSG_TYPE_WIRE_ACK = "__wire_ack__"  # fedlint: disable=protocol-exhaustiveness
 
 # Canonical arg keys (reference message.py:15-35).
 MSG_ARG_KEY_TYPE = "msg_type"

@@ -355,7 +355,10 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                    help="rank->IP csv (reference grpc_ipconfig.csv); default loopback")
     p.add_argument("--grpc_base_port", type=int, default=defaults.grpc_base_port)
     p.add_argument("--frequency_of_the_test", type=int, default=defaults.frequency_of_the_test)
-    p.add_argument("--is_mobile", type=int, default=defaults.is_mobile)
+    # reference-parity flag: its JSON wire format lives in
+    # core/serialization.tree_to_jsonable and is superseded by --wire_codec;
+    # kept so reference launch scripts parse unchanged.
+    p.add_argument("--is_mobile", type=int, default=defaults.is_mobile)  # fedlint: disable=config-flag-drift
     p.add_argument("--seed", type=int, default=defaults.seed)
     p.add_argument("--ci", type=int, default=defaults.ci)
     p.add_argument("--dtype", type=str, default=defaults.dtype)

@@ -23,7 +23,6 @@ from fedml_tpu.distributed.topology import SymmetricTopologyManager
 LOG = logging.getLogger(__name__)
 
 MSG_TYPE_SEND_MSG_TO_NEIGHBOR = 7
-MSG_TYPE_FINISH = 8
 MSG_ARG_KEY_PARAMS = "params"
 
 

@@ -433,8 +433,8 @@ class GKTEdgeClientManager(ClientManager):
             os.replace(tmp, self._state_path)
 
 
-def run_fedgkt_edge(dataset, config, pair=None, client_blocks: int = 3,
-                    server_blocks_per_stage: int = 9,
+def run_fedgkt_edge(dataset, config, pair=None, client_blocks=None,
+                    server_blocks_per_stage=None,
                     wire_roundtrip: bool = True, comm_factory=None):
     """Launch server + one manager per client over the local transport (or
     gRPC loopback via ``comm_factory``) and run the full feature/logit

@@ -168,7 +168,10 @@ def _run_experiment(config: FedConfig, algorithm: str) -> dict:
     if algorithm == "fedgkt":
         from fedml_tpu.algorithms.fedgkt import FedGKTAPI
 
-        blocks = (1, 2) if config.ci else (3, 9)
+        from fedml_tpu.models.gkt import gkt_blocks_from_names
+
+        blocks = (1, 2) if config.ci else gkt_blocks_from_names(
+            config.model_client, config.model_server)
         # multi-chip: shard the server phase over all chips (the reference
         # auto-uses nn.DataParallel when GPUs allow, GKTServerTrainer.py:28-29).
         # Auto only on real accelerators — GSPMD-partitioning the server scan

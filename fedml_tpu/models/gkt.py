@@ -16,6 +16,7 @@ DataParallel server loop (GKTServerTrainer.py:28-29).
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Any
 
@@ -98,6 +99,25 @@ class GKTPair:
     client: GKTHalfBundle
     server: GKTHalfBundle
     feature_shape: tuple          # single-example feature-map shape
+
+
+def gkt_blocks_from_names(model_client: str, model_server: str) -> tuple:
+    """--model_client/--model_server (reference names ``resnet8`` /
+    ``resnet56_server``) -> (client_blocks, server_blocks_per_stage).
+
+    The client half is a single-stage CIFAR ResNet, depth = 2n + 2, so
+    resnet8 -> 3 blocks; the server half is the standard 3-stage CIFAR
+    ResNet, depth = 6n + 2, so resnet56_server -> 9 blocks per stage.
+    """
+    def depth(name: str) -> int:
+        m = re.search(r"(\d+)", name)
+        if not m:
+            raise ValueError(f"cannot parse a ResNet depth out of {name!r}")
+        return int(m.group(1))
+
+    client_blocks = max((depth(model_client) - 2) // 2, 1)
+    server_blocks = max((depth(model_server) - 2) // 6, 1)
+    return client_blocks, server_blocks
 
 
 def create_gkt_pair(

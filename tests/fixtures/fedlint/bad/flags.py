@@ -1,0 +1,13 @@
+"""Known-bad config-flag-drift fixture: dead flag + misspelled read."""
+import argparse
+
+
+def add_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--used_flag", type=int, default=0)
+    p.add_argument("--dead_flag", type=int, default=0)
+    return p
+
+
+def consume(config):
+    return config.used_flag + config.not_a_flag

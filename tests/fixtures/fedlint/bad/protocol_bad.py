@@ -1,0 +1,12 @@
+"""Known-bad protocol fixture: orphan constant + unknown registration."""
+MSG_TYPE_ORPHAN = 1
+MSG_TYPE_HANDLED = 2
+
+
+class Manager:
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def register(self):
+        self.register_message_receive_handler(MSG_TYPE_HANDLED, id)
+        self.register_message_receive_handler(MSG_TYPE_GHOST, id)

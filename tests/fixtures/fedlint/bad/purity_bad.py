@@ -1,0 +1,23 @@
+"""Known-bad traced-purity fixture: clocks, host RNG, I/O, self-mutation."""
+import time
+
+import numpy as np
+from jax import jit
+
+
+@jit
+def traced(x):
+    t = time.time()
+    noise = np.random.default_rng(0).normal()
+    print("loss", t)
+    return x + noise
+
+
+class Trainer:
+    def __init__(self):
+        self.calls = 0
+
+    @jit
+    def step(self, x):
+        self.calls += 1
+        return x

@@ -1,0 +1,10 @@
+"""Known-bad retrace fixture: str param into jit, f-string in traced body."""
+from jax import jit
+
+
+def make_step():
+    def step(x, mode="train"):
+        label = f"mode={mode}"
+        return x, label
+
+    return jit(step)

@@ -1,0 +1,7 @@
+"""Known-bad seeded-rng fixture: argless default_rng draws OS entropy."""
+import numpy as np
+
+
+def sample_clients():
+    rng = np.random.default_rng()
+    return rng.integers(0, 10, size=3)

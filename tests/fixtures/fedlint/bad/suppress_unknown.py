@@ -1,0 +1,4 @@
+"""A suppression naming an unknown rule is itself an error."""
+import numpy as np
+
+rng = np.random.default_rng()  # fedlint: disable=seeded-rmg

@@ -1,0 +1,12 @@
+"""Clean twin: every flag read somewhere, every read backed by a flag."""
+import argparse
+
+
+def add_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--used_flag", type=int, default=0)
+    return p
+
+
+def consume(config):
+    return config.used_flag

@@ -1,0 +1,13 @@
+"""Clean twin: every constant handled or declared send-only."""
+MSG_TYPE_SYNC = 1
+MSG_TYPE_FINISH = 2
+
+SEND_ONLY_MSG_TYPES = frozenset({MSG_TYPE_FINISH})
+
+
+class Manager:
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def register(self):
+        self.register_message_receive_handler(MSG_TYPE_SYNC, id)

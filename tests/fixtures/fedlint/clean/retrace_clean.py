@@ -1,0 +1,11 @@
+"""Clean twin: static_argnames declared; f-string only inside a raise."""
+from jax import jit
+
+
+def make_step():
+    def step(x, mode="train"):
+        if x.ndim != 2:
+            raise ValueError(f"expected 2-D, got {x.ndim}")
+        return x
+
+    return jit(step, static_argnames=("mode",))

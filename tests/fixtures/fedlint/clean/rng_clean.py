@@ -1,0 +1,7 @@
+"""Clean twin: generator derived from an explicit seed."""
+import numpy as np
+
+
+def sample_clients(seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 10, size=3)

@@ -1,0 +1,5 @@
+"""A justified suppression silences the finding (it lands in .suppressed)."""
+import numpy as np
+
+# fixture documents the suppression syntax; entropy is intentional here
+rng = np.random.default_rng()  # fedlint: disable=seeded-rng

@@ -1,0 +1,144 @@
+"""fedlint — analyzer unit tests over the fixture corpus + the tier-1 gate.
+
+The fixture corpus (tests/fixtures/fedlint/) carries a known-bad and a
+clean twin snippet per rule; the tests pin EXACT rule IDs and line
+numbers so a resolver regression cannot silently widen or narrow a rule.
+The gate test at the bottom is the tier-1 contract: the real fedml_tpu
+tree must lint clean (suppressions carry their justification in-source).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fedml_tpu.analysis import RULES, run_lint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "fixtures", "fedlint")
+BAD = os.path.join(FIXTURES, "bad")
+CLEAN = os.path.join(FIXTURES, "clean")
+
+
+def _by_file(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(os.path.basename(f.path), []).append((f.rule, f.line))
+    return {k: sorted(v) for k, v in out.items()}
+
+
+def test_rule_catalog_complete():
+    # the five shipped rules + the suppression-integrity meta rule
+    assert set(RULES) == {
+        "traced-purity", "retrace-hazard", "seeded-rng",
+        "protocol-exhaustiveness", "config-flag-drift", "bad-suppression",
+    }
+
+
+def test_bad_corpus_exact_rule_ids_and_lines():
+    got = _by_file(run_lint(BAD).findings)
+    assert got == {
+        "purity_bad.py": [
+            ("traced-purity", 10),   # time.time() in a jitted body
+            ("traced-purity", 11),   # np.random.* in a jitted body
+            ("traced-purity", 12),   # print() in a jitted body
+            ("traced-purity", 22),   # self.calls mutation in a jitted method
+        ],
+        "retrace_bad.py": [
+            ("retrace-hazard", 6),   # str param enters jit un-static (at def)
+            ("retrace-hazard", 7),   # f-string inside the traced body
+        ],
+        "rng_bad.py": [("seeded-rng", 6)],
+        "protocol_bad.py": [
+            ("protocol-exhaustiveness", 2),   # MSG_TYPE_ORPHAN unhandled
+            ("protocol-exhaustiveness", 12),  # MSG_TYPE_GHOST undefined
+        ],
+        "flags.py": [
+            ("config-flag-drift", 8),   # --dead_flag never read
+            ("config-flag-drift", 13),  # .not_a_flag has no defining flag
+        ],
+        "suppress_unknown.py": [
+            # the unknown rule is an error AND does not suppress anything
+            ("bad-suppression", 4),
+            ("seeded-rng", 4),
+        ],
+    }
+
+
+def test_clean_corpus_zero_findings():
+    result = run_lint(CLEAN)
+    assert result.findings == [], [f.format() for f in result.findings]
+
+
+def test_suppression_silences_and_is_recorded():
+    result = run_lint(CLEAN)
+    assert [(f.rule, os.path.basename(f.path), f.line)
+            for f in result.suppressed] == [("seeded-rng", "suppressed.py", 5)]
+
+
+def test_unknown_rule_selection_raises():
+    with pytest.raises(ValueError, match="unknown fedlint rule"):
+        run_lint(CLEAN, rules=["not-a-rule"])
+
+
+def test_rule_selection_restricts_catalog():
+    result = run_lint(BAD, rules=["seeded-rng"])
+    assert {f.rule for f in result.findings} == {"seeded-rng"}
+    assert len(result.findings) == 2  # rng_bad.py + suppress_unknown.py
+
+
+def test_reintroducing_unseeded_rng_fails_at_the_exact_line(tmp_path):
+    """Acceptance: reverting turboaggregate's seeded-rng fix must trip the
+    seeded-rng rule at the regressed line."""
+    src_path = os.path.join(REPO, "fedml_tpu", "algorithms", "turboaggregate.py")
+    with open(src_path, encoding="utf-8") as f:
+        src = f.read()
+    fixed = "rng = _require_rng(rng)"
+    regression = "rng = rng or np.random.default_rng()"
+    assert fixed in src, "the seeded-rng fix is gone from turboaggregate.py"
+    bad_src = src.replace(fixed, regression, 1)
+    bad_line = 1 + bad_src[: bad_src.index(regression)].count("\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "turboaggregate.py").write_text(bad_src, encoding="utf-8")
+    result = run_lint(str(pkg))
+    assert [(f.rule, f.line) for f in result.findings] == [
+        ("seeded-rng", bad_line)
+    ], [f.format() for f in result.findings]
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fedlint.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_json_exit_codes_and_payload():
+    bad = _run_cli(BAD, "--format", "json")
+    assert bad.returncode == 1, bad.stderr
+    payload = json.loads(bad.stdout)
+    assert payload["ok"] is False
+    assert {f["rule"] for f in payload["findings"]} == {
+        "traced-purity", "retrace-hazard", "seeded-rng",
+        "protocol-exhaustiveness", "config-flag-drift", "bad-suppression",
+    }
+    clean = _run_cli(CLEAN, "--format", "json")
+    assert clean.returncode == 0, clean.stderr
+    payload = json.loads(clean.stdout)
+    assert payload["ok"] is True and payload["findings"] == []
+    assert len(payload["suppressed"]) == 1
+
+
+def test_fedml_tpu_tree_zero_unsuppressed_findings():
+    """The tier-1 gate: the real package must lint clean. A finding here
+    means new code broke an invariant — fix it, or suppress in place with
+    a justification comment (docs/DESIGN.md 'Static analysis (fedlint)')."""
+    result = run_lint(os.path.join(REPO, "fedml_tpu"))
+    assert result.findings == [], "\n" + "\n".join(
+        f.format() for f in result.findings
+    )
