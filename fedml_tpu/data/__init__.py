@@ -15,6 +15,7 @@ reference's --dataset names.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -84,6 +85,60 @@ class FedDataset:
         """One client's (x, y, mask) — the streaming paradigm's accessor
         (virtual datasets materialize it on demand)."""
         return self.train_x[k], self.train_y[k], self.train_mask[k]
+
+    def client_slice_cached(self, k: int, cap: int = 64):
+        """Single-client :meth:`client_slice` behind a tiny per-dataset LRU.
+
+        The edge/streaming call sites re-request the SAME client's slice
+        every epoch/round (the reference's DataLoader-per-client contract,
+        FedAVGTrainer.py:4-52); for virtual cross-device datasets each
+        request re-materializes the client's records from its RNG stream.
+        The LRU makes repeats O(1) and keeps a CrossDeviceDataset's
+        ``materialized_rows`` proportional to UNIQUE clients requested, not
+        epochs x rounds. Returned arrays are shared across callers and
+        must be treated as read-only. Thread-safe and SINGLE-FLIGHT:
+        concurrent misses for the same client materialize once and share
+        the result (the host round pipeline prefetches adjacent rounds
+        concurrently, and adjacent cohorts can share clients)."""
+        from concurrent.futures import Future
+
+        k = int(k)
+        lock = self.__dict__.setdefault("_client_lru_lock", threading.Lock())
+        cache = self.__dict__.setdefault("_client_lru", {})
+        pending = self.__dict__.setdefault("_client_lru_pending", {})
+        with lock:
+            hit = cache.get(k)
+            if hit is not None:
+                cache[k] = cache.pop(k)    # dict order is recency
+                return hit
+            fut = pending.get(k)
+            if fut is None:
+                fut = pending[k] = Future()
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            return fut.result()
+        try:
+            out = self.client_slice(np.asarray([k]))
+            for a in out:
+                if isinstance(a, np.ndarray):
+                    # enforce the read-only contract: an in-place write
+                    # through a cached slice would silently corrupt every
+                    # later hit — make it an immediate ValueError instead
+                    a.flags.writeable = False
+        except BaseException as e:
+            with lock:
+                pending.pop(k, None)       # next request retries fresh
+            fut.set_exception(e)
+            raise
+        with lock:
+            cache[k] = out
+            while len(cache) > cap:
+                cache.pop(next(iter(cache)))
+            pending.pop(k, None)
+        fut.set_result(out)
+        return out
 
 
 def load_dataset(name: str, **kw) -> FedDataset:

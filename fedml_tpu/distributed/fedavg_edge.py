@@ -525,7 +525,7 @@ class FedAVGTrainer:
             return jax.tree.map(np.asarray, variables), 0.0
         trees, counts = [], []
         for ci in self.client_indices:
-            x, y, m, count = self.dataset.client_slice(np.asarray([ci]))
+            x, y, m, count = self.dataset.client_slice_cached(ci)
             rng = jax.random.fold_in(round_key(root_key, round_idx), ci)
             res = self.local_train(variables, x[0], y[0], m[0], np.float32(count[0]), rng)
             trees.append(res.variables)

@@ -527,7 +527,7 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
                                             server_trainer, deadline=deadline,
                                             max_turns=max_turns)
         k = rank - 1
-        x, y, m, count = dataset.client_slice(np.asarray([k]))
+        x, y, m, count = dataset.client_slice_cached(k)
         n_real = int(count[0])
         # ceil: a trailing partial batch trains with its padding rows masked
         # out (padded rows sit at the END of each client's arrays)

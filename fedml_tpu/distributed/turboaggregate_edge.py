@@ -271,7 +271,7 @@ class TAEdgeClientManager(ClientManager):
             self._relay_in = np.zeros(1, np.int64)  # ring head starts at 0
         variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         w = float(msg.get(KEY_WEIGHT))
-        x, y, m, count = self.dataset.client_slice(np.asarray([self.client_idx]))
+        x, y, m, count = self.dataset.client_slice_cached(self.client_idx)
         rng = jax.random.split(round_key(self.root_key, self.round_idx),
                                self.num_clients)[self.client_idx]
         res = self.local_train(variables, x[0], y[0], m[0],
@@ -657,8 +657,7 @@ class TAThresholdClientManager(ClientManager):
         self._shares = {}
         variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         w = float(msg.get(KEY_WEIGHT))
-        x, y, m, count = self.dataset.client_slice(
-            np.asarray([self.client_idx]))
+        x, y, m, count = self.dataset.client_slice_cached(self.client_idx)
         rng = jax.random.split(round_key(self.root_key, self.round_idx),
                                self.num_clients)[self.client_idx]
         res = self.local_train(variables, x[0], y[0], m[0],

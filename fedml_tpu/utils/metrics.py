@@ -56,6 +56,35 @@ class RoundTimer:
         return out
 
 
+def round_stats(rows, depth: int = 0) -> dict:
+    """Aggregate per-round stage timings for the host round pipeline
+    (data/pipeline.CohortPrefetcher) into one observability record.
+
+    Each row is one executed round: ``materialize_ms`` (host cohort
+    materialization + cast), ``h2d_ms`` (host->device transfer),
+    ``compute_ms`` (the blocking round-step call), ``wait_ms`` (how long
+    the consumer actually blocked on the round's inputs — the EXPOSED part
+    of the host stages; the serial path records wait = materialize + h2d
+    since nothing overlaps there).
+
+    ``overlap_frac`` is the share of host-stage milliseconds hidden behind
+    device compute: 1 - wait/(materialize + h2d). 0 on the serial path by
+    construction; -> 1 as the pipeline fully hides cohort preparation."""
+    rows = list(rows)
+    keys = ("materialize_ms", "h2d_ms", "compute_ms", "wait_ms")
+    if not rows:
+        return {"rounds": 0, "pipeline_depth": int(depth), "overlap_frac": 0.0,
+                **{k: 0.0 for k in keys}}
+    tot = {k: float(sum(r.get(k, 0.0) for r in rows)) for k in keys}
+    host = tot["materialize_ms"] + tot["h2d_ms"]
+    overlap = max(0.0, 1.0 - tot["wait_ms"] / host) if host > 0 else 0.0
+    out = {k: round(tot[k] / len(rows), 3) for k in keys}
+    out["rounds"] = len(rows)
+    out["pipeline_depth"] = int(depth)
+    out["overlap_frac"] = round(overlap, 4)
+    return out
+
+
 class MetricsLogger:
     """wandb-compatible logger with gated backends.
 

@@ -103,12 +103,86 @@ def test_streaming_paradigm_at_scale(ds):
         client_num_per_round=4, comm_round=1, batch_size=10, epochs=1,
         lr=0.1, seed=0, frequency_of_the_test=10_000)
     bundle = create_model("lr", ds.class_num, input_shape=(32,))
+    ds.__dict__.pop("_client_lru", None)   # count real materializations only
     ds.materialized_rows = 0
     api = StreamingFedAvgAPI(ds, cfg, bundle)
     loss = float(api.run_round(1))
     assert np.isfinite(loss)
     n_pad = ds.train_x.shape[1]
     assert ds.materialized_rows == 4 * n_pad
+
+
+def test_single_client_lru_keeps_rows_o_unique_clients(ds):
+    """The edge/streaming call sites re-request the same client's slice
+    every epoch/round; the per-dataset LRU must keep materialized_rows
+    proportional to UNIQUE clients — O(rounds x cohort x n_pad) overall —
+    never O(epochs x rounds x ...)."""
+    ds.__dict__.pop("_client_lru", None)
+    ds.materialized_rows = 0
+    n_pad = ds.train_x.shape[1]
+    clients, epochs, rounds = [7, 8, 9], 5, 3
+    for _r in range(rounds):
+        for k in clients:
+            for _e in range(epochs):
+                ds.client_slice_cached(k)
+    assert ds.materialized_rows == len(clients) * n_pad
+    # cache hits return exactly what a fresh materialization would
+    xc, yc, mc, cc = ds.client_slice_cached(7)
+    xf, yf, mf, cf = ds.client_slice(np.asarray([7]))
+    assert np.array_equal(xc, xf) and np.array_equal(yc, yf)
+    assert np.array_equal(mc, mf) and np.array_equal(cc, cf)
+    # eviction keeps the cache tiny and correct past the cap
+    for k in range(70):
+        ds.client_slice_cached(k, cap=8)
+    assert len(ds._client_lru) <= 8
+    xa, _, _, _ = ds.client_slice_cached(69)
+    assert np.array_equal(xa, ds.client_slice(np.asarray([69]))[0])
+
+
+def test_multilabel_gen_documented_draw_order():
+    """The vectorized multilabel generator (Gumbel top-k tag sampling)
+    follows the documented per-client draw order EXACTLY: dirichlet pref,
+    poisson k_tags, gumbel[n, classes] scores, standard_normal feature
+    noise — pinned by replaying that order here. Every record activates
+    k_tags distinct tags; features are the mean of the selected tags'
+    class means plus unit noise."""
+    from fedml_tpu.data.crossdevice import _client_rng
+
+    dim, classes, n_clients, seed = 6, 7, 20, 11
+    ds = make_synthetic_crossdevice(
+        "ml-pin", dim, classes, n_clients, batch_size=5, mean_records=8.0,
+        max_records=15, multilabel=True, label_alpha=0.3, separation=1.0,
+        seed=seed)
+    cid = 4
+    x, y, m, counts = ds.client_slice(np.asarray([cid]))
+    n = int(counts[0])
+
+    # replay the loader's global draws: counts, then shared class means
+    gl = np.random.default_rng(seed)
+    _counts = np.clip(gl.lognormal(np.log(8.0), 0.8, n_clients), 1, 15)
+    means = gl.standard_normal((classes, dim)).astype(np.float32) * 1.0
+
+    # replay the client's documented stream
+    rng = _client_rng(seed, cid)
+    pref = rng.dirichlet(np.full(classes, 0.3))
+    k_tags = 1 + rng.poisson(1.0, n).clip(max=4)
+    with np.errstate(divide="ignore"):
+        scores = np.log(pref)[None, :] + rng.gumbel(size=(n, classes))
+    order = np.argsort(-scores, axis=1, kind="stable")[:, :int(k_tags.max())]
+    sel = np.arange(order.shape[1])[None, :] < k_tags[:, None]
+    want_y = np.zeros((n, classes), np.float32)
+    want_y[np.arange(n)[:, None], order] = sel.astype(np.float32)
+    w = (sel / k_tags[:, None]).astype(np.float32)
+    want_x = means[order[:, 0]] * w[:, 0:1]
+    for j in range(1, order.shape[1]):
+        want_x += means[order[:, j]] * w[:, j:j + 1]
+    want_x += rng.standard_normal((n, dim)).astype(np.float32)
+
+    np.testing.assert_array_equal(y[0, :n], want_y)
+    np.testing.assert_array_equal(x[0, :n], want_x)
+    # semantics: k distinct tags per record, padding rows stay zero
+    assert np.array_equal(want_y.sum(1).astype(np.int64), k_tags)
+    assert not y[0, n:].any() and not m[0, n:].any()
 
 
 def test_stackoverflow_full_loader_registered():

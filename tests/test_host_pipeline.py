@@ -1,0 +1,255 @@
+"""Host round pipeline (data/pipeline.CohortPrefetcher): prefetched,
+donated, overlapped cross-device rounds must be BIT-IDENTICAL to the
+serial host path for every config — the plan is a pure function of
+(seed, round_idx) and parallel per-client materialization cannot change a
+record — and the pipeline's failure modes must surface loudly: a
+background exception raises at the next run_round, teardown drains, and
+restore-then-continue from a mid-run checkpoint replays exactly."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.streaming_fedavg import StreamingFedAvgAPI
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data.crossdevice import make_synthetic_crossdevice
+from fedml_tpu.data.pipeline import CohortPrefetcher
+from fedml_tpu.models import create_model
+from fedml_tpu.utils.metrics import round_stats
+
+N_CLIENTS, COHORT, DIM = 150, 4, 8
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return make_synthetic_crossdevice(
+        "xdev-pipe", DIM, 5, N_CLIENTS, batch_size=4, mean_records=9.0,
+        max_records=25, seed=2)
+
+
+def _cfg(depth, rounds=3, **kw):
+    return FedConfig(
+        model="lr", dataset="xdev-pipe", client_num_in_total=N_CLIENTS,
+        client_num_per_round=COHORT, comm_round=rounds, batch_size=4,
+        epochs=1, lr=0.2, seed=1, frequency_of_the_test=10_000,
+        host_pipeline_depth=depth, **kw)
+
+
+def _run(ds, cfg, cls=FedAvgAPI, start=0):
+    api = cls(ds, cfg, create_model("lr", ds.class_num, input_shape=(DIM,)))
+    try:
+        losses = [float(api.run_round(r)) for r in range(start, cfg.comm_round)]
+        leaves = [np.asarray(l) for l in jax.tree.leaves(api.variables)]
+    finally:
+        api.close()
+    return losses, leaves
+
+
+@pytest.mark.parametrize("kw", [
+    {},                                              # bucketed
+    {"bucket_quantum_batches": 0},                   # unbucketed
+    {"async_rounds": True},                          # bucketed + async
+    {"bucket_quantum_batches": 0, "async_rounds": True},
+    {"failure_prob": 0.3},                           # elastic rounds
+], ids=["bucketed", "unbucketed", "bucketed-async", "unbucketed-async",
+        "failures"])
+def test_pipeline_bit_identical_to_serial(ds, kw):
+    l0, v0 = _run(ds, _cfg(0, **kw))
+    l2, v2 = _run(ds, _cfg(2, **kw))
+    assert l0 == l2
+    for a, b in zip(v0, v2):
+        assert np.array_equal(a, b)
+
+
+def test_pipeline_streaming_bit_identical(ds):
+    l0, v0 = _run(ds, _cfg(0), cls=StreamingFedAvgAPI)
+    l2, v2 = _run(ds, _cfg(2), cls=StreamingFedAvgAPI)
+    assert l0 == l2
+    for a, b in zip(v0, v2):
+        assert np.array_equal(a, b)
+
+
+def test_pipeline_restore_mid_run_bit_identical(ds, tmp_path):
+    """Checkpoint at round 2 of 5, restore into a FRESH pipelined API, and
+    continue: the tail must equal the uninterrupted pipelined run (and,
+    transitively via the A/B test, the serial path)."""
+    rounds = 5
+    full_losses, full_leaves = _run(ds, _cfg(2, rounds=rounds))
+
+    bundle = create_model("lr", ds.class_num, input_shape=(DIM,))
+    api = FedAvgAPI(ds, _cfg(2, rounds=rounds), bundle)
+    head = [float(api.run_round(r)) for r in range(2)]
+    ckpt = str(tmp_path / "mid.ckpt")
+    api.save(ckpt, round_idx=2)
+    api.close()
+
+    api2 = FedAvgAPI(ds, _cfg(2, rounds=rounds),
+                     create_model("lr", ds.class_num, input_shape=(DIM,)))
+    start = api2.restore(ckpt)
+    assert start == 2
+    tail = [float(api2.run_round(r)) for r in range(start, rounds)]
+    leaves = [np.asarray(l) for l in jax.tree.leaves(api2.variables)]
+    api2.close()
+
+    assert head + tail == full_losses
+    for a, b in zip(full_leaves, leaves):
+        assert np.array_equal(a, b)
+
+
+def test_background_exception_surfaces_no_hang(ds):
+    """A materializer crash inside the background build is held in the
+    round's future and re-raised by the run_round that consumes it — the
+    consumer fails loudly instead of hanging on a dead pipeline."""
+    api = FedAvgAPI(ds, _cfg(2, rounds=6),
+                    create_model("lr", ds.class_num, input_shape=(DIM,)))
+    # poison round 3's cohort only, via a marker client no other round in
+    # the window samples: rounds 0-2 must run fine even while round 3's
+    # prefetched future already holds the exception
+    from fedml_tpu.core.rng import sample_clients
+
+    def cohort(r):
+        return set(sample_clients(r, N_CLIENTS, COHORT, seed=1).tolist())
+
+    only_r3 = cohort(3) - set().union(*[cohort(r) for r in (0, 1, 2, 4, 5)])
+    assert only_r3, "fixture drift: round 3 shares every client with its window"
+    marker = min(only_r3)
+    inner = ds._materialize
+
+    def poisoned(ids):
+        if marker in np.asarray(ids).tolist():
+            raise ValueError("injected materializer crash")
+        return inner(ids)
+
+    ds._materialize = poisoned
+    try:
+        for r in range(3):
+            assert np.isfinite(float(api.run_round(r)))
+        with pytest.raises(ValueError, match="injected materializer crash"):
+            api.run_round(3)
+    finally:
+        ds._materialize = inner
+        api.close()
+
+
+def test_close_drains_and_api_stays_usable(ds):
+    api = FedAvgAPI(ds, _cfg(2, rounds=4),
+                    create_model("lr", ds.class_num, input_shape=(DIM,)))
+    l0 = float(api.run_round(0))
+    pf = api._prefetcher
+    assert pf is not None and pf._inflight
+    api.close()
+    assert not pf._inflight
+    api.close()                       # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        pf.pop(1)
+    # the API itself lazily rebuilds a fresh pipeline and keeps training
+    l1 = float(api.run_round(1))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert api._prefetcher is not pf
+    api.close()
+
+
+def test_prefetcher_out_of_order_pop_and_eviction():
+    """pop order jumps (bench re-runs, checkpoint restore) build on demand
+    and evict speculative rounds outside the new window."""
+    built = []
+
+    def build(r, _pool):
+        built.append(r)
+        return r * 10, {"materialize_ms": 0.0, "h2d_ms": 0.0}
+
+    with CohortPrefetcher(build, depth=2, workers=1) as pf:
+        payload, _stages, _wait = pf.pop(5)
+        assert payload == 50
+        assert sorted(pf._inflight) == [6, 7]
+        payload, _stages, _wait = pf.pop(0)   # jump backward
+        assert payload == 0
+        assert sorted(pf._inflight) == [1, 2]
+
+
+def test_prefetcher_speculation_bound_is_adaptive():
+    """Rounds >= max_round are never built ahead (the schedule ends), but
+    a driver that explicitly pops past the bound raises it — observed
+    demand beats the static schedule (the bench pops [1, comm_round])."""
+    def build(r, _pool):
+        return r, {"materialize_ms": 0.0, "h2d_ms": 0.0}
+
+    with CohortPrefetcher(build, depth=2, workers=1, max_round=3) as pf:
+        pf.prime(0, wait=True)                  # steady-state warm-up
+        assert sorted(pf._inflight) == [0, 1]
+        assert pf.pop(1)[2] < 50.0              # primed: no cold-build wait
+        assert sorted(pf._inflight) == [2]      # 3 is past the schedule
+        pf.pop(3)                               # explicit pop raises bound
+        assert pf.max_round == 4
+        pf.pop(2)
+        assert sorted(pf._inflight) == [3]
+        # SUSTAINED past-schedule demand (a driver ignoring comm_round)
+        # reopens the window entirely instead of going silently serial
+        pf.pop(4)
+        pf.pop(5)
+        assert pf.max_round is None
+        assert sorted(pf._inflight) == [6, 7]
+
+
+def test_train_does_not_speculate_past_schedule(ds):
+    """train() pops rounds [0, comm_round): the pipeline must build exactly
+    those — materialized_rows identical to the serial path, teardown never
+    waits on a wasted tail build."""
+    rounds = 3
+    n_pad = ds.train_x.shape[1]
+    for depth in (0, 2):
+        ds.__dict__.pop("_client_lru", None)
+        ds.materialized_rows = 0
+        api = FedAvgAPI(ds, _cfg(depth, rounds=rounds),
+                        create_model("lr", ds.class_num, input_shape=(DIM,)))
+        api.train()
+        assert ds.materialized_rows == rounds * COHORT * n_pad, depth
+
+
+def test_pipeline_streaming_failures_materialization_parity(ds):
+    """Streaming + failure injection: the background build materializes
+    LIVE clients only, exactly like the serial per-client loop — same
+    losses, same model, same materialized_rows."""
+    kw = {"failure_prob": 0.4}
+    rows = []
+    outs = []
+    for depth in (0, 2):
+        ds.__dict__.pop("_client_lru", None)
+        ds.materialized_rows = 0
+        outs.append(_run(ds, _cfg(depth, rounds=4, **kw),
+                         cls=StreamingFedAvgAPI))
+        rows.append(ds.materialized_rows)
+    (l0, v0), (l2, v2) = outs
+    assert l0 == l2
+    for a, b in zip(v0, v2):
+        assert np.array_equal(a, b)
+    assert rows[0] == rows[1]
+
+
+def test_round_stats_overlap_accounting():
+    serial = [{"materialize_ms": 40.0, "h2d_ms": 10.0, "compute_ms": 50.0,
+               "wait_ms": 50.0}] * 4
+    piped = [{"materialize_ms": 40.0, "h2d_ms": 10.0, "compute_ms": 50.0,
+              "wait_ms": 5.0}] * 4
+    s = round_stats(serial, depth=0)
+    p = round_stats(piped, depth=2)
+    assert s["overlap_frac"] == 0.0 and s["pipeline_depth"] == 0
+    assert p["overlap_frac"] == 0.9 and p["pipeline_depth"] == 2
+    assert p["materialize_ms"] == 40.0 and p["rounds"] == 4
+    empty = round_stats([], depth=3)
+    assert empty["rounds"] == 0 and empty["overlap_frac"] == 0.0
+
+
+def test_run_round_records_stage_rows(ds):
+    api = FedAvgAPI(ds, _cfg(0, rounds=2),
+                    create_model("lr", ds.class_num, input_shape=(DIM,)))
+    for r in range(2):
+        api.run_round(r)
+    rows = list(api._stage_rows)
+    api.close()
+    assert len(rows) == 2
+    # serial path: host stages fully exposed -> zero overlap by definition
+    assert round_stats(rows, 0)["overlap_frac"] == 0.0
+    assert all(r["materialize_ms"] > 0 for r in rows)

@@ -1,0 +1,151 @@
+"""Multi-seed pipeline-on/off A/B over the cross-device host round path.
+
+For every seed, runs the same small cross-device federation twice — serial
+(--host_pipeline_depth 0) and pipelined (depth D) — across the config grid
+{bucketed, unbucketed} x {async_rounds off, on}, and verifies that
+
+- every run COMPLETES within the watchdog timeout (a wedged prefetcher
+  thread or a deadlocked pop surfaces as a reported hang, never a silent
+  CI stall);
+- per-round losses are BIT-IDENTICAL between the serial and pipelined
+  runs (the pipeline's whole determinism contract: the per-round plan is
+  a pure function of (seed, round_idx), parallel per-client
+  materialization cannot change a record);
+- the final model leaves are bit-identical too.
+
+Exit status is non-zero if ANY cell hangs or mismatches, so this slots
+straight into CI next to tools/chaos_sweep.py.
+
+Usage: python tools/xdev_ab.py [out.json] [--seeds N] [--rounds R]
+                               [--depth D] [--clients C] [--cohort K]
+                               [--timeout S]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _arg(argv, flag, default, cast=float):
+    if flag in argv:
+        return cast(argv[argv.index(flag) + 1])
+    return default
+
+
+def _run_with_watchdog(fn, timeout: float):
+    """fn() on a daemon thread; (result, error_str). A hang cannot wedge
+    the sweep — the daemon thread dies with the process."""
+    out: dict = {}
+
+    def target():
+        try:
+            out["result"] = fn()
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            out["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        return None, f"hang: run exceeded {timeout:.0f}s watchdog"
+    return out.get("result"), out.get("error")
+
+
+def main(argv):
+    out_path = argv[0] if argv and not argv[0].startswith("-") else None
+    seeds = _arg(argv, "--seeds", 3, int)
+    rounds = _arg(argv, "--rounds", 4, int)
+    depth = _arg(argv, "--depth", 2, int)
+    clients = _arg(argv, "--clients", 400, int)
+    cohort = _arg(argv, "--cohort", 6, int)
+    timeout = _arg(argv, "--timeout", 180.0)
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.crossdevice import make_synthetic_crossdevice
+    from fedml_tpu.models import create_model
+
+    grid = [
+        {"name": "bucketed", "kw": {}},
+        {"name": "unbucketed", "kw": {"bucket_quantum_batches": 0}},
+        {"name": "bucketed+async", "kw": {"async_rounds": True}},
+        {"name": "unbucketed+async",
+         "kw": {"bucket_quantum_batches": 0, "async_rounds": True}},
+    ]
+
+    results, failed = [], 0
+    for seed in range(seeds):
+        ds = make_synthetic_crossdevice(
+            f"xdev-ab-{seed}", 16, 8, clients, batch_size=4,
+            mean_records=10.0, max_records=33, multilabel=True, seed=seed)
+        bundle_kw = dict(input_shape=(16,))
+
+        def run(pipeline_depth, kw):
+            cfg = FedConfig(
+                model="lr", dataset="xdev-ab", client_num_in_total=clients,
+                client_num_per_round=cohort, comm_round=rounds, batch_size=4,
+                epochs=1, lr=0.1, seed=seed, frequency_of_the_test=10_000,
+                failure_prob=0.2, host_pipeline_depth=pipeline_depth, **kw)
+            api = FedAvgAPI(ds, cfg, create_model("lr", ds.class_num,
+                                                  **bundle_kw))
+            try:
+                losses = [float(api.run_round(r)) for r in range(rounds)]
+                leaves = [np.asarray(l) for l in jax.tree.leaves(api.variables)]
+            finally:
+                api.close()
+            return losses, leaves
+
+        for cell in grid:
+            rec = {"seed": seed, "config": cell["name"], "ok": False}
+            base, err = _run_with_watchdog(lambda: run(0, cell["kw"]), timeout)
+            if err is None:
+                piped, err = _run_with_watchdog(
+                    lambda: run(depth, cell["kw"]), timeout)
+            if err is not None:
+                rec["error"] = err
+            elif base[0] != piped[0]:
+                rec["error"] = (f"loss mismatch: serial {base[0]} != "
+                                f"pipelined {piped[0]}")
+            elif not all(np.array_equal(a, b)
+                         for a, b in zip(base[1], piped[1])):
+                rec["error"] = "final model leaves differ"
+            else:
+                rec["ok"] = True
+                rec["losses"] = base[0]
+            if not rec["ok"]:
+                failed += 1
+                print(f"seed {seed} [{cell['name']}]: FAIL ({rec['error']})",
+                      file=sys.stderr)
+            else:
+                print(f"seed {seed} [{cell['name']}]: ok")
+            results.append(rec)
+
+    summary = {
+        "seeds": seeds, "failed": failed, "depth": depth,
+        "rounds": rounds, "clients": clients, "cohort": cohort,
+        "results": results,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps({"seeds": seeds, "cells": len(results),
+                      "failed": failed}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    rc = main(sys.argv[1:])
+    # hard exit: a genuinely wedged build leaks non-daemon executor
+    # threads that concurrent.futures' atexit hook would join forever —
+    # the exact CI stall the watchdog exists to prevent
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)
