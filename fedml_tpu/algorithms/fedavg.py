@@ -596,13 +596,30 @@ class FedAvgAPI:
         (fanned out over the cohort's clients on ``pool``), then ship
         host->device — all while the in-flight round computes. Returns the
         device-resident payload plus stage timings (round_stats)."""
+        from fedml_tpu.obs import tracer_if_enabled
+
+        tr = tracer_if_enabled(0)
         t0 = time.perf_counter()
-        cx, cy, cm, counts = self._host_round_inputs(
-            round_idx, pool, n_chunks=getattr(pool, "_max_workers", 0))
-        t1 = time.perf_counter()
-        payload = (jax.device_put(cx), jax.device_put(cy),
-                   jax.device_put(cm), jax.device_put(counts))
-        jax.block_until_ready(payload)
+        if tr is None:
+            cx, cy, cm, counts = self._host_round_inputs(
+                round_idx, pool, n_chunks=getattr(pool, "_max_workers", 0))
+            t1 = time.perf_counter()
+            payload = (jax.device_put(cx), jax.device_put(cy),
+                       jax.device_put(cm), jax.device_put(counts))
+            jax.block_until_ready(payload)
+        else:
+            # these spans live on the prefetcher's background threads — in
+            # the timeline they sit beside (not under) the consuming round,
+            # which is exactly the overlap the pipeline exists to create
+            with tr.span("materialize", cat="prefetch",
+                         args={"round": round_idx}):
+                cx, cy, cm, counts = self._host_round_inputs(
+                    round_idx, pool, n_chunks=getattr(pool, "_max_workers", 0))
+            t1 = time.perf_counter()
+            with tr.span("h2d", cat="prefetch", args={"round": round_idx}):
+                payload = (jax.device_put(cx), jax.device_put(cy),
+                           jax.device_put(cm), jax.device_put(counts))
+                jax.block_until_ready(payload)
         t2 = time.perf_counter()
         return payload, {"materialize_ms": (t1 - t0) * 1e3,
                          "h2d_ms": (t2 - t1) * 1e3}
@@ -667,6 +684,15 @@ class FedAvgAPI:
         """Execute one round; returns the weighted train loss — a host float,
         or (config.async_rounds) the un-synced device scalar so consecutive
         rounds pipeline; callers that do host arithmetic must float() it."""
+        from fedml_tpu.obs import tracer_if_enabled
+
+        tr = tracer_if_enabled(0)
+        if tr is None:
+            return self._run_round_inner(round_idx)
+        with tr.span("round", cat="round", args={"round": round_idx}):
+            return self._run_round_inner(round_idx)
+
+    def _run_round_inner(self, round_idx: int) -> "float | jax.Array":
         rk = round_key(self.root_key, round_idx)
         if self._dev_train is not None:
             sampled, live, bucket = self._round_plan(round_idx, record=True)
@@ -732,9 +758,22 @@ class FedAvgAPI:
             )
             if not self.config.async_rounds:
                 train_loss = float(train_loss)
-            self._stage_rows.append(dict(
-                stages, wait_ms=wait_ms, round=round_idx,
-                compute_ms=(time.perf_counter() - t0) * 1e3))
+            row = dict(stages, wait_ms=wait_ms, round=round_idx,
+                       compute_ms=(time.perf_counter() - t0) * 1e3)
+            self._stage_rows.append(row)
+            from fedml_tpu.obs import default_registry, tracer_if_enabled
+
+            # the registry's stage-row record mirrors _stage_rows (the
+            # round_stats view) so registry readers (MetricsLogger,
+            # tests) see the same numbers the summary reports; the trace
+            # analyzer gets its copy via the host_stages counter below
+            default_registry().append_row("stage", row)
+            tr = tracer_if_enabled(0)
+            if tr is not None:
+                tr.counter("host_stages", {
+                    k: row[k] for k in
+                    ("materialize_ms", "h2d_ms", "compute_ms", "wait_ms")},
+                    args={"round": round_idx})
         return train_loss if self.config.async_rounds else float(train_loss)
 
     def save(self, path: str, round_idx: int = 0, orbax: bool = False) -> None:
@@ -782,9 +821,15 @@ class FedAvgAPI:
         return finalize_metrics(jax.tree.map(np.asarray, sums))
 
     def train(self) -> dict:
+        from fedml_tpu.obs import (configure_from, default_registry,
+                                   flush_all, tracing_enabled)
         from fedml_tpu.utils.metrics import MetricsLogger, RoundTimer, profile_trace
 
         c = self.config
+        configure_from(c)
+        # the registry row store is process-wide; start this run's stage
+        # record clean so readers don't see earlier runs' rounds interleaved
+        default_registry().clear_rows("stage")
         timer = RoundTimer()
         logger = MetricsLogger(c.run_name, c.enable_wandb, config=c.to_dict())
         start_round = 0
@@ -799,6 +844,8 @@ class FedAvgAPI:
             # outlive the run (speculative builds are dropped harmlessly —
             # every payload is a pure function of round_idx)
             self.close()
+            if tracing_enabled():
+                flush_all()
         timing = timer.summary()
         if self._stage_rows:
             from fedml_tpu.utils.metrics import round_stats

@@ -176,7 +176,8 @@ class StreamingFedAvgAPI(FedAvgAPI):
         tau = jnp.float32(c.epochs * steps_real)
         return variables, last_loss, tau
 
-    def run_round(self, round_idx: int):
+    def _run_round_inner(self, round_idx: int):
+        # traced via the base run_round wrapper (one "round" span per round)
         sampled, live, _bucket = self._round_plan(round_idx, record=True)
         rk = round_key(self.root_key, round_idx)
         keys = jax.random.split(rk, len(sampled))
@@ -207,9 +208,12 @@ class StreamingFedAvgAPI(FedAvgAPI):
             losses.append(l)
             taus.append(tau)
         if stages is not None:
-            self._stage_rows.append(dict(
-                stages, wait_ms=wait_ms, round=round_idx,
-                compute_ms=(time.perf_counter() - t0) * 1e3))
+            row = dict(stages, wait_ms=wait_ms, round=round_idx,
+                       compute_ms=(time.perf_counter() - t0) * 1e3)
+            self._stage_rows.append(row)
+            from fedml_tpu.obs import default_registry
+
+            default_registry().append_row("stage", row)
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
         res = LocalResult(stacked, jnp.stack(losses), jnp.stack(taus))
         self.variables, self.server_state, train_loss = self._finish_jit(

@@ -73,10 +73,13 @@ class ChaosCommManager(BaseCommunicationManager, Observer):
         self._held = None              # reorder buffer: (msg, delay_s)
         self._crashed = False
         self._lock = threading.Lock()
-        self.stats = {
-            "sent": 0, "dropped": 0, "duplicated": 0, "delayed": 0,
-            "reordered": 0, "crashed_dropped": 0, "crash_stops": 0,
-        }
+        # registry-backed counter view (fedml_tpu/obs) — same keys/access
+        from fedml_tpu.obs import default_registry
+
+        self.stats = default_registry().group("chaos", rank=self.rank, keys=(
+            "sent", "dropped", "duplicated", "delayed",
+            "reordered", "crashed_dropped", "crash_stops",
+        ))
         inner.add_observer(self)
 
     # -- deterministic fate ------------------------------------------------
@@ -116,6 +119,13 @@ class ChaosCommManager(BaseCommunicationManager, Observer):
             if r_drop < self.drop:
                 with self._lock:   # counters race: concurrent retransmit sends
                     self.stats["dropped"] += 1
+                from fedml_tpu.obs import tracer_if_enabled
+
+                tr = tracer_if_enabled(self.rank)
+                if tr is not None:
+                    tr.instant("chaos_drop", cat="wire", args={
+                        "peer": int(msg.get_receiver_id()),
+                        "msg_type": str(msg.get_type())})
                 return
             copies = 2 if r_dup < self.dup else 1
             if copies == 2:

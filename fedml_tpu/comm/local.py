@@ -114,8 +114,18 @@ def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
     errors: Dict[int, BaseException] = {}
 
     def _run(rank: int, m) -> None:
+        from fedml_tpu.obs import tracer_if_enabled
+
+        tr = tracer_if_enabled(rank)
         try:
-            m.run()
+            if tr is None:
+                m.run()
+            else:
+                # rank lifecycle span: everything the rank does (handler
+                # recv spans included) nests under it in the timeline
+                with tr.span("rank_run", cat="lifecycle",
+                             args={"rank": rank}):
+                    m.run()
         except BaseException as e:  # propagate to the caller, unblock peers
             errors[rank] = e
             for c in comms:
@@ -125,12 +135,21 @@ def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
         threading.Thread(target=_run, args=(r, m), daemon=True, name=f"rank{r}")
         for r, m in enumerate(managers)
     ]
+    from fedml_tpu.obs import flush_all, tracing_enabled
+
     for t in threads:
         t.start()
-    for t in threads:
-        t.join(timeout=timeout)
-        if t.is_alive() and not errors:
-            raise TimeoutError(f"rank thread {t.name} did not finish within {timeout}s")
+    try:
+        for t in threads:
+            t.join(timeout=timeout)
+            if t.is_alive() and not errors:
+                raise TimeoutError(f"rank thread {t.name} did not finish within {timeout}s")
+    finally:
+        if tracing_enabled():
+            # flush per-rank trace files even on timeout/failure: a
+            # federation that hung or crashed is exactly the one whose
+            # timeline is needed
+            flush_all()
     if errors:
         rank, err = sorted(errors.items())[0]
         raise RuntimeError(f"rank {rank} raised during run_ranks") from err

@@ -18,7 +18,8 @@ import logging
 from typing import Callable, Dict
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import MSG_ARG_KEY_TRACE_CTX, Message
+from fedml_tpu.obs import tracer_if_enabled
 
 LOG = logging.getLogger(__name__)
 
@@ -51,10 +52,35 @@ class _ManagerBase(Observer):
         if handler is None:
             LOG.warning("rank %d: no handler for msg_type=%r", self.rank, msg_type)
             return
-        handler(msg_params)
+        tr = tracer_if_enabled(self.rank)
+        if tr is None:
+            handler(msg_params)
+            return
+        # recv span: linked to the sender's send span by the message uid in
+        # the envelope's trace context; the parent id makes the causal chain
+        # explicit even before the analyzer joins the per-rank files
+        ctx = msg_params.get(MSG_ARG_KEY_TRACE_CTX)
+        args = {"msg_type": str(msg_type),
+                "peer": int(msg_params.get_sender_id())}
+        if ctx:
+            args["mid"] = ctx[2]
+            args["send_sid"] = ctx[1]
+            args["send_trace"] = ctx[0]
+        with tr.span("recv", cat="comm", args=args):
+            handler(msg_params)
 
     def send_message(self, message: Message) -> None:
-        self.com_manager.send_message(message)
+        tr = tracer_if_enabled(self.rank)
+        if tr is None:
+            self.com_manager.send_message(message)
+            return
+        with tr.span("send", cat="comm") as sp:
+            ctx = tr.make_ctx(sp.span_id)
+            message.add_params(MSG_ARG_KEY_TRACE_CTX, ctx)
+            sp.set("msg_type", str(message.get_type()))
+            sp.set("peer", int(message.get_receiver_id()))
+            sp.set("mid", ctx[2])
+            self.com_manager.send_message(message)
 
     def finish(self) -> None:
         """Graceful drain-and-stop (NOT the reference's COMM_WORLD.Abort)."""

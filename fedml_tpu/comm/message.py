@@ -37,6 +37,12 @@ MSG_ARG_KEY_WIRE_INC = "__wire_inc__"
 # dispatch, deliberately outside the handler registry — registering one
 # would deliver acks to application code.
 MSG_TYPE_WIRE_ACK = "__wire_ack__"  # fedlint: disable=protocol-exhaustiveness
+# Trace context (fedml_tpu/obs, DESIGN.md §12): (trace id, parent span id,
+# message uid), stamped by the traced send in comm/managers.py and read
+# back at dispatch so a recv span links to the send span that caused it —
+# across ranks, transports, and the reliable/chaos middleware. Handlers
+# never read it; messages from an untraced peer simply lack the key.
+MSG_ARG_KEY_TRACE_CTX = "__trace_ctx__"
 
 # Canonical arg keys (reference message.py:15-35).
 MSG_ARG_KEY_TYPE = "msg_type"

@@ -104,11 +104,16 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
         self._cv = threading.Condition(self._lock)
         self._stopping = False
         self._closed = False
-        self.stats = {
-            "sent": 0, "retransmits": 0, "retransmit_errors": 0,
-            "gave_up": 0, "acked": 0, "acks_sent": 0,
-            "delivered": 0, "dup_dropped": 0,
-        }
+        # counters are a CounterGroup view over the unified registry
+        # (fedml_tpu/obs): same dict-style access and key names as before,
+        # but registry.snapshot("wire") now sees every live layer at once
+        from fedml_tpu.obs import default_registry
+
+        self.stats = default_registry().group("wire", rank=self.rank, keys=(
+            "sent", "retransmits", "retransmit_errors",
+            "gave_up", "acked", "acks_sent",
+            "delivered", "dup_dropped",
+        ))
         inner.add_observer(self)
         self._retx = threading.Thread(
             target=self._retransmit_loop, daemon=True,
@@ -201,6 +206,19 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                                  name=f"wire-retx-{self.rank}-send").start()
 
     def _retransmit_one(self, p: _Pending) -> None:
+        from fedml_tpu.obs import tracer_if_enabled
+
+        tr = tracer_if_enabled(self.rank)
+        if tr is not None:
+            # tagged with the SAME message uid as the original send span, so
+            # the analyzer collapses a retransmit storm onto its one logical
+            # wire edge instead of counting phantom messages
+            from fedml_tpu.comm.message import MSG_ARG_KEY_TRACE_CTX
+
+            ctx = p.msg.get(MSG_ARG_KEY_TRACE_CTX)
+            tr.instant("retransmit", cat="wire", args={
+                "peer": p.receiver, "attempt": p.attempts,
+                **({"mid": ctx[2]} if ctx else {})})
         key = "retransmits"
         try:
             self.inner.send_message(p.msg)

@@ -206,6 +206,17 @@ class FedConfig:
     # observability
     run_name: str = "fedml_tpu"
     enable_wandb: bool = False
+    # fedtrace span tracing (fedml_tpu/obs, DESIGN.md §12): when set, every
+    # rank writes <trace_dir>/trace-rank<r>.jsonl — spans for rounds,
+    # message send/recv (stitched cross-rank by message id), pipeline
+    # stages, wire retransmits — for tools/trace_report.py or a Perfetto
+    # export. None (default) disables tracing entirely: the hot paths see
+    # one global flag check and allocate nothing, and a traced run is
+    # bit-identical to an untraced one (the tracer only reads clocks).
+    trace_dir: Optional[str] = None
+    # ring-buffer bound per rank: oldest events fall off instead of
+    # growing the heap on a weeks-long federation
+    trace_buffer_events: int = 65536
 
     # checkpoint/resume (absent in the reference, SURVEY.md §5.4)
     checkpoint_dir: Optional[str] = None
@@ -252,6 +263,9 @@ class FedConfig:
         if self.host_pipeline_workers < 0:
             raise ValueError(
                 f"host_pipeline_workers must be >= 0, got {self.host_pipeline_workers}")
+        if self.trace_buffer_events < 1:
+            raise ValueError(
+                f"trace_buffer_events must be >= 1, got {self.trace_buffer_events}")
         if self.checkpoint_frequency < 1:
             raise ValueError(
                 f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"
@@ -427,6 +441,12 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--chaos_crash_rank", type=int, default=None,
                    help="crash-stop this rank after --chaos_crash_after sends")
     p.add_argument("--chaos_crash_after", type=int, default=None)
+    p.add_argument("--trace_dir", type=str, default=None,
+                   help="write per-rank span traces (fedml_tpu/obs) here; "
+                        "analyze with tools/trace_report.py")
+    p.add_argument("--trace_buffer_events", type=int,
+                   default=defaults.trace_buffer_events,
+                   help="per-rank trace ring-buffer bound (events)")
     p.add_argument("--run_name", type=str, default=defaults.run_name)
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)

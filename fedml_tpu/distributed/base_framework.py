@@ -157,8 +157,17 @@ class BaseClientManager(ClientManager):
         self.finish()
 
 
-def run_base_framework(client_num: int, comm_round: int = 3, wire_roundtrip: bool = True):
-    """In-process launch of server + clients (reference's `mpirun -np N`)."""
+def run_base_framework(client_num: int, comm_round: int = 3, wire_roundtrip: bool = True,
+                       config=None):
+    """In-process launch of server + clients (reference's `mpirun -np N`).
+
+    ``config`` (a FedConfig or anything with the wire/chaos fields) layers
+    the reliable/chaos wire middleware over the transport exactly like the
+    fedavg_edge launcher — without it ``--wire_reliable``/``--chaos_*``
+    were silently ignored for this protocol (ROADMAP wire-reliability gap).
+    """
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+    from fedml_tpu.obs import configure_from
 
     class Args:
         pass
@@ -166,11 +175,15 @@ def run_base_framework(client_num: int, comm_round: int = 3, wire_roundtrip: boo
     args = Args()
     args.comm_round = comm_round
     size = client_num + 1
+    if config is not None:
+        configure_from(config)
 
     def make(rank, comm):
         if rank == 0:
             return BaseServerManager(args, comm, rank, size)
         return BaseClientManager(args, comm, rank, size)
 
-    managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip)
+    managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip,
+                         wrap=wire_wrap_factory(config) if config is not None
+                         else None)
     return managers[0].global_history

@@ -106,12 +106,20 @@ class DecentralizedWorkerManager(ClientManager):
 
 
 def run_decentralized_framework(worker_num: int, comm_round: int = 3, neighbor_num: int = 2,
-                                wire_roundtrip: bool = True):
+                                wire_roundtrip: bool = True, config=None):
     """In-process gossip launch; returns the per-worker mixed histories.
 
     With a doubly-stochastic symmetric topology the mixed values converge to
     the global mean — the property the test asserts.
+
+    ``config`` layers the reliable/chaos wire middleware over the transport
+    (closing the ROADMAP wire-reliability gap for this protocol): gossip
+    advances each worker's round by counting in-neighbor messages, so a
+    single dropped neighbor result hangs the whole mesh — exactly the
+    barrier the reliable layer exists to protect.
     """
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+    from fedml_tpu.obs import configure_from
 
     class Args:
         pass
@@ -120,9 +128,13 @@ def run_decentralized_framework(worker_num: int, comm_round: int = 3, neighbor_n
     args.comm_round = comm_round
     topo = SymmetricTopologyManager(worker_num, neighbor_num=neighbor_num, seed=0)
     topo.generate_topology()
+    if config is not None:
+        configure_from(config)
 
     def make(rank, comm):
         return DecentralizedWorkerManager(args, comm, rank, worker_num, topo)
 
-    managers = run_ranks(make, worker_num, wire_roundtrip=wire_roundtrip)
+    managers = run_ranks(make, worker_num, wire_roundtrip=wire_roundtrip,
+                         wrap=wire_wrap_factory(config) if config is not None
+                         else None)
     return [m.history for m in managers]

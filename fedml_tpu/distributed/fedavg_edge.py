@@ -312,6 +312,17 @@ class FedAvgEdgeServerManager(ServerManager):
         workers will actually train from (delta uploads reconstruct against
         it — computing it once here keeps sync and reconstruction the same
         bytes by construction instead of re-encoding per upload)."""
+        from fedml_tpu.obs import tracer_if_enabled
+
+        tr = tracer_if_enabled(self.rank)
+        if tr is not None:
+            # the server's round span opens at broadcast and closes in
+            # _complete_round — a different handler invocation, so it is a
+            # keyed cross-method span, not a context manager. An all-fail
+            # re-broadcast of the same round re-opens the key: the span then
+            # measures the LAST attempt, and the earlier one is dropped.
+            tr.begin_span(("round", self.round_idx), "round", cat="round",
+                          args={"round": self.round_idx, "role": "server"})
         override = self._downlink_codec()
         effective = override if override is not None else getattr(
             self.aggregator.config, "wire_codec", "raw")
@@ -460,8 +471,18 @@ class FedAvgEdgeServerManager(ServerManager):
         self._complete_round()
 
     def _complete_round(self):
+        from fedml_tpu.obs import tracer_if_enabled
+
         self._cancel_timer()
-        global_params = self.aggregator.aggregate()
+        tr = tracer_if_enabled(self.rank)
+        if tr is None:
+            global_params = self.aggregator.aggregate()
+        else:
+            with tr.span("aggregate", cat="round",
+                         args={"round": self.round_idx,
+                               "uploads": len(self.aggregator.model_dict)}):
+                global_params = self.aggregator.aggregate()
+            tr.end_span(("round", self.round_idx))
         if self._deadline is not None:
             for i in self.aggregator.flag_client_model_uploaded_dict:
                 self.aggregator.flag_client_model_uploaded_dict[i] = False
@@ -612,7 +633,15 @@ class FedAvgEdgeClientManager(ClientManager):
         if tag is not None:
             self.round_idx = int(tag)
         self._bcast_gen = msg.get(MSG_ARG_KEY_GEN)
-        self._do_train_and_send(msg)
+        from fedml_tpu.obs import tracer_if_enabled
+
+        tr = tracer_if_enabled(self.rank)
+        if tr is None:
+            self._do_train_and_send(msg)
+        else:
+            with tr.span("round", cat="round",
+                         args={"round": self.round_idx, "role": "worker"}):
+                self._do_train_and_send(msg)
 
     def handle_message_finish(self, msg: Message):
         self.finish()
@@ -731,7 +760,9 @@ def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = Tru
     transport via ``comm_factory`` (e.g. gRPC loopback). Returns the
     server's aggregator (holding the final global model + test history)."""
     from fedml_tpu.core.rng import seed_everything
+    from fedml_tpu.obs import configure_from
 
+    configure_from(config)
     bundle = create_model(config.model, dataset.class_num, input_shape=dataset.train_x.shape[2:] or None)
     root_key = seed_everything(config.seed)
     size = worker_num + 1
@@ -797,14 +828,21 @@ def run_fedavg_edge_rank(dataset, config):
         else 120.0,
     )
     from fedml_tpu.comm.reliable import wire_wrap_factory
+    from fedml_tpu.obs import configure_from, flush_all, tracing_enabled
 
+    configure_from(config)
     wrap = wire_wrap_factory(config)
     if wrap is not None:
         comm = wrap(config.rank, comm)
     manager = build_edge_rank(dataset, config, config.rank, config.world_size, comm)
     LOG.info("rank %d/%d entering run loop (grpc base port %d)",
              config.rank, config.world_size, config.grpc_base_port)
-    manager.run()
+    try:
+        manager.run()
+    finally:
+        # per-rank deployment: THIS process owns only its own rank's trace
+        if tracing_enabled():
+            flush_all()
     from fedml_tpu.utils.metrics import wire_stats
 
     stats = wire_stats(comm)

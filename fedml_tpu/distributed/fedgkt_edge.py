@@ -442,7 +442,9 @@ def run_fedgkt_edge(dataset, config, pair=None, client_blocks=None,
     ``.api``). Reuses a FedGKTAPI instance as the program/state host so the
     wire run shares init and jitted compute with the simulation."""
     from fedml_tpu.algorithms.fedgkt import FedGKTAPI
+    from fedml_tpu.obs import configure_from
 
+    configure_from(config)
     codec = getattr(config, "wire_codec", "raw")
     if codec.startswith("topk"):
         # topk is a DELTA compressor (error feedback absorbs the unsent

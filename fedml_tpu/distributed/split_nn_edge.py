@@ -499,7 +499,9 @@ def run_splitnn_edge(dataset, config, client_bundle, server_bundle,
     still run (its data is simply unseen — the same drop semantics as
     fedavg_edge's partial aggregation)."""
     from fedml_tpu.core.rng import seed_everything
+    from fedml_tpu.obs import configure_from
 
+    configure_from(config)
     deadline = getattr(config, "straggler_deadline_sec", None)
 
     task = get_task(dataset.task, dataset.class_num)

@@ -747,6 +747,9 @@ def run_turboaggregate_edge(dataset, config, group_size: int = 2,
     With ``config.straggler_deadline_sec`` set, runs the BGW threshold
     protocol instead of the strict additive ring: up to live-(T+1) clients
     may die mid-round and the server still reconstructs the aggregate."""
+    from fedml_tpu.obs import configure_from
+
+    configure_from(config)
     C = min(config.client_num_in_total, dataset.num_clients)
     bundle = create_model(config.model, dataset.class_num,
                           input_shape=dataset.train_x.shape[2:] or None)

@@ -278,7 +278,7 @@ def run_vfl_edge(dataset, hidden_dim: int = 16, lr: float = 0.01,
                  batch_size: int = 64, epochs: int = 10, seed: int = 0,
                  wire_roundtrip: bool = True, comm_factory=None,
                  straggler_deadline_sec=None, checkpoint_dir=None,
-                 resume: bool = False):
+                 resume: bool = False, config=None):
     """Launch guest (rank 0) + one host per remaining party over the local
     transport (or gRPC via ``comm_factory``). Same init derivation as
     build_protocol_vfl(seed) and same batch schedule as VFLAPI.fit(epochs,
@@ -342,6 +342,16 @@ def run_vfl_edge(dataset, hidden_dim: int = 16, lr: float = 0.01,
                               state_path=host_path(rank) if host_path else None,
                               resume=resume)
 
+    # ``config`` layers the reliable/chaos wire middleware over the
+    # transport (ROADMAP wire-reliability gap): VFL's strict all-parties
+    # barrier cannot drop a participant, so a lossy wire MUST be recovered
+    # by retransmit — there is no deadline fallback for this protocol.
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+    from fedml_tpu.obs import configure_from
+
+    if config is not None:
+        configure_from(config)
     run_ranks(make, size, wire_roundtrip=wire_roundtrip,
-              comm_factory=comm_factory)
+              comm_factory=comm_factory,
+              wrap=wire_wrap_factory(config) if config is not None else None)
     return holder["guest"]

@@ -1,0 +1,54 @@
+"""fedtrace: span tracing + unified metrics registry (DESIGN.md §12).
+
+The paper's observability story is rank-0 wandb scalars plus ad-hoc
+wall-clock pairs; this package is the reproduction's replacement — the
+timing instrumentation FedJAX ships built-in (arXiv:2108.02117) and the
+cross-rank visibility FedML Parrot's heterogeneity-aware scheduling
+assumes (arXiv:2303.01778):
+
+- :mod:`fedml_tpu.obs.registry` — one process-wide
+  :class:`MetricsRegistry`; every counter surface in the tree
+  (``RoundTimer`` phase sums, the reliable/chaos wire counters, pipeline
+  stage rows) is a :class:`CounterGroup` attached to it, so the existing
+  public APIs become *views* over one store instead of four disjoint dicts.
+- :mod:`fedml_tpu.obs.tracer` — per-rank span tracer: monotonic
+  durations, ring-buffered events, allocation-free when disabled. Trace
+  context piggybacks on ``comm/message.py`` envelopes so send spans stitch
+  to recv spans across ranks and transports by message id.
+- :mod:`fedml_tpu.obs.export` — Perfetto/Chrome ``trace_event`` JSON and
+  JSONL exporters; ``tools/trace_report.py`` is the analyzer.
+
+Tracing is OFF by default and enabled per run via ``--trace_dir``
+(core/config.py). The contract: a traced run is bit-identical to an
+untraced run — the tracer only ever reads clocks.
+"""
+
+from fedml_tpu.obs.registry import (
+    CounterGroup,
+    MetricsRegistry,
+    default_registry,
+)
+from fedml_tpu.obs.tracer import (
+    Tracer,
+    configure,
+    configure_from,
+    flush_all,
+    get_tracer,
+    reset,
+    tracer_if_enabled,
+    tracing_enabled,
+)
+
+__all__ = [
+    "CounterGroup",
+    "MetricsRegistry",
+    "Tracer",
+    "configure",
+    "configure_from",
+    "default_registry",
+    "flush_all",
+    "get_tracer",
+    "reset",
+    "tracer_if_enabled",
+    "tracing_enabled",
+]

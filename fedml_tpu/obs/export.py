@@ -1,0 +1,97 @@
+"""Trace exporters: JSONL (native) and Perfetto/Chrome ``trace_event``.
+
+The native on-disk format is one JSON object per line (what
+``Tracer.flush`` appends): ``ph`` is the event kind — ``X`` complete span,
+``i`` instant, ``C`` counter, ``O`` unclosed-at-flush span, ``M`` file
+metadata. :func:`to_chrome_trace` converts a merged multi-rank event list
+into the ``trace_event`` JSON that Perfetto / ``chrome://tracing`` loads
+directly: rank -> ``pid`` (one process track per rank), thread -> ``tid``,
+and every send/recv span pair linked by message uid becomes a flow arrow
+(``ph: s``/``f``) so the cross-rank causal chain is drawn, not inferred.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Optional
+
+
+def read_jsonl(path: str) -> list[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+def write_jsonl(path: str, events: Iterable[dict]) -> None:
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def _mid(ev: dict) -> Optional[str]:
+    return (ev.get("args") or {}).get("mid")
+
+
+def to_chrome_trace(events: Iterable[dict]) -> dict:
+    """Convert merged per-rank events into a ``trace_event`` JSON object
+    (``{"traceEvents": [...]}``). Metadata lines become process_name
+    entries; send->recv message uids become flow events."""
+    out = []
+    seen_ranks = set()
+    sends: dict[str, dict] = {}
+    recvs: dict[str, dict] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        rank = int(ev.get("rank", 0))
+        if rank not in seen_ranks:
+            seen_ranks.add(rank)
+            out.append({"ph": "M", "name": "process_name", "pid": rank,
+                        "args": {"name": f"rank {rank}"}})
+        base = {"name": ev.get("name"), "cat": ev.get("cat", "app"),
+                "ts": ev.get("ts", 0), "pid": rank,
+                "tid": ev.get("tid", 0)}
+        ev_args = dict(ev.get("args") or {})
+        if ph == "X":
+            out.append({**base, "ph": "X", "dur": ev.get("dur", 0),
+                        "args": ev_args})
+            m = ev_args.get("mid")
+            if m is not None:
+                (sends if ev.get("name") == "send" else recvs)[m] = ev
+        elif ph == "i":
+            out.append({**base, "ph": "i", "s": "t", "args": ev_args})
+        elif ph == "C":
+            vals = ev_args.get("values") or {}
+            # Chrome counter events take flat numeric args
+            out.append({**base, "ph": "C",
+                        "args": {k: v for k, v in vals.items()
+                                 if isinstance(v, (int, float))}})
+        elif ph == "O":
+            # unclosed span: render as a zero-length instant flagged
+            out.append({**base, "ph": "i", "s": "p",
+                        "args": {**ev_args, "unclosed": True}})
+    # flow arrows: one per (send, recv) pair sharing a message uid
+    for m, s in sends.items():
+        r = recvs.get(m)
+        if r is None:
+            continue
+        flow = {"name": "msg", "cat": "comm", "id": _flow_id(m)}
+        out.append({**flow, "ph": "s", "ts": s.get("ts", 0),
+                    "pid": int(s.get("rank", 0)), "tid": s.get("tid", 0)})
+        out.append({**flow, "ph": "f", "bp": "e",
+                    "ts": r.get("ts", 0) + int(r.get("dur", 0) or 0),
+                    "pid": int(r.get("rank", 0)), "tid": r.get("tid", 0)})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def _flow_id(mid: str) -> int:
+    # trace_event flow ids are integers; fold the hex uid down
+    return int(mid[:12], 16) if mid else 0
+
+
+def write_chrome_trace(path: str, events: Iterable[dict]) -> None:
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(events), f)

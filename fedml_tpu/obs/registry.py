@@ -1,0 +1,165 @@
+"""Unified metrics registry: one store behind every counter surface.
+
+Before this module the tree had four disjoint metric surfaces with no
+shared schema: ``RoundTimer.sums`` (per-phase dicts), the reliable layer's
+``stats`` dict, the chaos layer's ``stats`` dict, and the pipeline's
+``_stage_rows``. Each keeps its exact public shape — dict-style reads and
+writes, same key names — but the dicts are now :class:`CounterGroup` views
+attached to the process-wide :class:`MetricsRegistry`, so one snapshot call
+answers "what did the wire/timing/pipeline counters across every live
+manager in this process add up to" without knowing who owns which dict.
+
+Design constraints inherited from the surfaces being unified:
+
+- writes stay lock-free on the hot path (the wire counters are bumped from
+  retransmit threads and were already documented as monotonic ints read
+  without locks — a CounterGroup write is one dict store, exactly as
+  before);
+- attaching a group never extends its owner's lifetime: the registry holds
+  weak references, a GC'd RoundTimer drops out of snapshots on its own;
+- groups are PER-OWNER (each manager, timer, pipeline keeps its own view,
+  so tests and concurrent runs stay isolated) while ``snapshot`` sums
+  across owners — the registry-level view is additive by construction,
+  mirroring ``merge_wire_stats``.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Iterator, Optional
+
+
+class CounterGroup:
+    """Dict-like counter view registered under a namespace.
+
+    Supports the exact access patterns of the dicts it replaces:
+    ``g["k"] += 1``, ``g.get("k", 0)``, ``g.items()``, ``"k" in g``,
+    ``dict(g)``. Values are plain numbers; writes are single dict stores
+    (no lock — the owners treat these as monotonic summary counters).
+    """
+
+    __slots__ = ("_data", "namespace", "rank", "__weakref__")
+
+    def __init__(self, namespace: str, rank: Optional[int] = None, keys=()):
+        self.namespace = namespace
+        self.rank = rank
+        self._data: dict = {k: 0 for k in keys}
+
+    def __getitem__(self, key):
+        return self._data[key]
+
+    def __setitem__(self, key, value) -> None:
+        self._data[key] = value
+
+    def get(self, key, default=None):
+        return self._data.get(key, default)
+
+    def items(self):
+        return self._data.items()
+
+    def keys(self):
+        return self._data.keys()
+
+    def values(self):
+        return self._data.values()
+
+    def __iter__(self) -> Iterator:
+        return iter(self._data)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, CounterGroup):
+            return self._data == other._data
+        return self._data == other
+
+    def __repr__(self) -> str:
+        return f"CounterGroup({self.namespace!r}, rank={self.rank}, {self._data!r})"
+
+    def update(self, other) -> None:
+        self._data.update(other)
+
+    def as_dict(self) -> dict:
+        return dict(self._data)
+
+
+class MetricsRegistry:
+    """Weak-ref'd collection of :class:`CounterGroup`\\ s by namespace."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._groups: dict[str, list] = {}          # namespace -> [weakref]
+        self._rows: dict[str, list[dict]] = {}      # namespace -> row records
+
+    def group(self, namespace: str, rank: Optional[int] = None,
+              keys=()) -> CounterGroup:
+        """Create and attach a new counter group under ``namespace``."""
+        g = CounterGroup(namespace, rank=rank, keys=keys)
+        with self._lock:
+            refs = self._groups.setdefault(namespace, [])
+            refs.append(weakref.ref(g))
+            # opportunistic purge of dead owners, keeps the list bounded
+            self._groups[namespace] = [r for r in refs if r() is not None]
+        return g
+
+    def _live(self, namespace: str) -> list[CounterGroup]:
+        with self._lock:
+            refs = list(self._groups.get(namespace, ()))
+        return [g for g in (r() for r in refs) if g is not None]
+
+    def namespaces(self) -> list[str]:
+        with self._lock:
+            return sorted(set(self._groups) | set(self._rows))
+
+    def snapshot(self, namespace: Optional[str] = None,
+                 rank: Optional[int] = None) -> dict:
+        """Sum counters across live groups. ``namespace=None`` walks every
+        namespace, prefixing keys ``<namespace>/<key>`` (the wandb-style
+        flat keying of utils/metrics.wire_stats). ``rank`` filters to
+        groups owned by that rank."""
+        if namespace is None:
+            out: dict = {}
+            for ns in self.namespaces():
+                for k, v in self.snapshot(ns, rank=rank).items():
+                    out[f"{ns}/{k}"] = v
+            return out
+        total: dict = {}
+        for g in self._live(namespace):
+            if rank is not None and g.rank is not None and g.rank != rank:
+                continue
+            for k, v in g.items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    # -- row records (per-round stage timings, utils/metrics.round_stats) --
+    def append_row(self, namespace: str, row: dict,
+                   cap: int = 4096) -> None:
+        with self._lock:
+            rows = self._rows.setdefault(namespace, [])
+            rows.append(dict(row))
+            if len(rows) > cap:
+                del rows[: len(rows) - cap]
+
+    def rows(self, namespace: str) -> list[dict]:
+        with self._lock:
+            return list(self._rows.get(namespace, ()))
+
+    def clear_rows(self, namespace: Optional[str] = None) -> None:
+        with self._lock:
+            if namespace is None:
+                self._rows.clear()
+            else:
+                self._rows.pop(namespace, None)
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every built-in surface attaches to."""
+    return _DEFAULT

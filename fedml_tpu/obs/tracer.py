@@ -1,0 +1,381 @@
+"""Per-rank span tracer with cross-rank causality.
+
+One :class:`Tracer` per rank (in-process federations run many ranks in one
+process; the per-rank deployment runs one per OS process). Each traces
+spans (duration events), instants, and counters into a bounded ring buffer
+— monotonic-clock durations, wall-clock timestamps for cross-process
+alignment — and flushes to ``<trace_dir>/trace-rank<r>.jsonl``.
+
+Causality across ranks: ``comm/message.py:MSG_ARG_KEY_TRACE_CTX``
+piggybacks ``(trace_id, parent span id, message uid)`` on every traced
+protocol send
+(stamped by ``comm/managers._ManagerBase.send_message``, read back on
+dispatch), so the analyzer (tools/trace_report.py) links each send span to
+the recv span that handled it BY MESSAGE ID, through every transport and
+through the reliable/chaos middleware — a retransmit storm collapses onto
+the one logical edge it belongs to.
+
+Overhead contract (pinned by tests/test_trace.py):
+
+- disabled (the default): ``tracer_if_enabled(rank)`` is a module-global
+  flag check returning ``None`` — call sites skip ALL tracing work,
+  allocating nothing;
+- enabled: one clock read at span open, one at close, one dict append into
+  a bounded ``deque`` (old events fall off; a trace can never exhaust
+  memory);
+- always: the tracer only reads clocks — a traced run's training outputs
+  are bit-identical to an untraced run's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Optional
+
+def _now_us() -> int:
+    # wall-clock µs for CROSS-PROCESS alignment of the per-rank files;
+    # durations always come from the monotonic clock below
+    return time.time_ns() // 1_000
+
+
+class _NoopSpan:
+    """Singleton returned by a disabled tracer's span() — enter/exit no-ops."""
+
+    __slots__ = ()
+    span_id = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, key, value) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tr", "name", "cat", "args", "span_id", "parent_id",
+                 "_ts_us", "_t0", "_jax_ann")
+
+    def __init__(self, tr: "Tracer", name: str, cat: str, args: Optional[dict],
+                 parent_id: Optional[int]):
+        self._tr = tr
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.span_id = tr._next_id()
+        self.parent_id = parent_id
+        self._ts_us = 0
+        self._t0 = 0.0
+        self._jax_ann = None
+
+    def set(self, key, value) -> None:
+        if self.args is None:
+            self.args = {}
+        self.args[key] = value
+
+    def __enter__(self):
+        tr = self._tr
+        stack = tr._stack()
+        if self.parent_id is None and stack:
+            self.parent_id = stack[-1]
+        stack.append(self.span_id)
+        if tr._jax_bridge is not None:
+            self._jax_ann = tr._jax_bridge(f"{self.cat}/{self.name}")
+            self._jax_ann.__enter__()
+        self._ts_us = _now_us()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(*exc)
+        tr = self._tr
+        stack = tr._stack()
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        tr._emit("X", self.name, self.cat, self._ts_us, dur_us,
+                 self.span_id, self.parent_id, self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe per-rank event buffer; see module docstring."""
+
+    def __init__(self, rank: int = 0, buffer_events: int = 65536,
+                 trace_id: Optional[str] = None):
+        self.rank = int(rank)
+        self.enabled = True
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        # deque.append is atomic under the GIL; the ring bound makes an
+        # unflushed long run degrade to keep-latest instead of OOM
+        self._ring: deque = deque(maxlen=int(buffer_events))
+        self._ids = iter(range(1, 1 << 62))
+        self._id_lock = threading.Lock()
+        self._tls = threading.local()
+        #: open cross-method spans: key -> (span_id, parent_id, name, cat,
+        #: ts_us, t0, args); e.g. the server's round span opens at broadcast
+        #: and closes at aggregate, in different handlers
+        self._open: dict = {}
+        self._open_lock = threading.Lock()
+        self._jax_bridge = None
+
+    # -- internals ---------------------------------------------------------
+    def _next_id(self) -> int:
+        with self._id_lock:
+            return next(self._ids)
+
+    def _stack(self) -> list:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    def _emit(self, ph: str, name: str, cat: str, ts_us: int, dur_us,
+              span_id, parent_id, args) -> None:
+        ev = {"ph": ph, "name": name, "cat": cat, "ts": ts_us,
+              "rank": self.rank, "tid": threading.get_ident() & 0xFFFF}
+        if dur_us is not None:
+            ev["dur"] = dur_us
+        if span_id:
+            ev["sid"] = span_id
+        if parent_id:
+            ev["psid"] = parent_id
+        if args:
+            ev["args"] = args
+        self._ring.append(ev)
+
+    # -- public API --------------------------------------------------------
+    def span(self, name: str, cat: str = "app", args: Optional[dict] = None,
+             parent: Optional[int] = None):
+        """Context manager tracing a duration event. ``parent`` overrides
+        the thread-ambient parent (used to stitch a recv span under the
+        sender's context)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return _Span(self, name, cat, args, parent)
+
+    def begin_span(self, key, name: str, cat: str = "app",
+                   args: Optional[dict] = None) -> int:
+        """Open a span that a DIFFERENT handler/thread will close (the
+        message-driven round spans). Returns the span id."""
+        if not self.enabled:
+            return 0
+        sid = self._next_id()
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        with self._open_lock:
+            self._open[key] = (sid, parent, name, cat, _now_us(),
+                               time.perf_counter(), dict(args or {}))
+        return sid
+
+    def end_span(self, key, args: Optional[dict] = None) -> None:
+        if not self.enabled:
+            return
+        with self._open_lock:
+            rec = self._open.pop(key, None)
+        if rec is None:
+            return
+        sid, parent, name, cat, ts_us, t0, a = rec
+        if args:
+            a.update(args)
+        self._emit("X", name, cat, ts_us,
+                   int((time.perf_counter() - t0) * 1e6), sid, parent, a)
+
+    def instant(self, name: str, cat: str = "app",
+                args: Optional[dict] = None) -> None:
+        if not self.enabled:
+            return
+        stack = self._stack()
+        self._emit("i", name, cat, _now_us(), None, 0,
+                   stack[-1] if stack else None, args)
+
+    def counter(self, name: str, values, cat: str = "counter",
+                args: Optional[dict] = None) -> None:
+        """Counter sample; ``values`` is a number or a {series: number}
+        dict (Chrome counter-event semantics)."""
+        if not self.enabled:
+            return
+        v = values if isinstance(values, dict) else {"value": values}
+        a = dict(args or {})
+        a["values"] = v
+        self._emit("C", name, cat, _now_us(), None, 0, None, a)
+
+    def make_ctx(self, span_id: int) -> list:
+        """Wire context for one message: (trace id, parent span id, uid)."""
+        return [self.trace_id, int(span_id), uuid.uuid4().hex[:16]]
+
+    def drain(self) -> list[dict]:
+        """Atomically take the buffered events (flush consumes them)."""
+        out = []
+        ring = self._ring
+        while True:
+            try:
+                out.append(ring.popleft())
+            except IndexError:
+                return out
+
+    def unclosed(self) -> list[dict]:
+        """Snapshot of still-open cross-method spans (emitted at flush with
+        ph="O" so the analyzer can flag a rank that died mid-round)."""
+        with self._open_lock:
+            items = list(self._open.items())
+        return [{"ph": "O", "name": name, "cat": cat, "ts": ts_us,
+                 "rank": self.rank, "sid": sid,
+                 **({"psid": parent} if parent else {}),
+                 **({"args": a} if a else {})}
+                for _k, (sid, parent, name, cat, ts_us, _t0, a) in items]
+
+    def flush(self, path: str, registry=None) -> int:
+        """Append drained events (+ a header and a per-rank counter
+        snapshot) to ``path`` as JSONL. Returns the event count written."""
+        events = self.drain()
+        extra = []
+        if registry is not None:
+            snap = registry.snapshot(rank=self.rank)
+            if snap:
+                extra.append({"ph": "C", "name": "registry", "cat": "registry",
+                              "ts": _now_us(), "rank": self.rank,
+                              "args": {"values": snap}})
+        extra.extend(self.unclosed())
+        if not events and not extra:
+            return 0
+        header = {"ph": "M", "name": "trace_meta", "rank": self.rank,
+                  "ts": _now_us(), "args": {"trace_id": self.trace_id}}
+        with open(path, "a") as f:
+            for ev in [header, *events, *extra]:
+                f.write(json.dumps(ev) + "\n")
+        return len(events) + len(extra)
+
+
+class _DisabledTracer(Tracer):
+    """Shared no-op tracer handed out while tracing is off; every public
+    entry point early-returns on ``enabled`` before touching state."""
+
+    def __init__(self):
+        super().__init__(rank=-1, buffer_events=1, trace_id="disabled")
+        self.enabled = False
+
+
+_DISABLED = _DisabledTracer()
+
+# -- process-wide hub ------------------------------------------------------
+
+_lock = threading.Lock()
+_ENABLED = False
+_TRACE_DIR: Optional[str] = None
+_BUFFER = 65536
+_TRACERS: dict[int, Tracer] = {}
+_TRACE_ID: Optional[str] = None
+_JAX_BRIDGE = False
+
+
+def configure(trace_dir: Optional[str], buffer_events: int = 65536,
+              jax_bridge: bool = False, trace_id: Optional[str] = None) -> None:
+    """Enable tracing into ``trace_dir`` (None disables). Existing
+    per-rank tracers are kept so an in-flight run reconfiguring is safe."""
+    global _ENABLED, _TRACE_DIR, _BUFFER, _TRACE_ID, _JAX_BRIDGE
+    with _lock:
+        _TRACE_DIR = trace_dir
+        _ENABLED = bool(trace_dir)
+        _BUFFER = max(int(buffer_events), 1)
+        _JAX_BRIDGE = bool(jax_bridge)
+        _TRACE_ID = trace_id or uuid.uuid4().hex[:16]
+        if _ENABLED:
+            os.makedirs(trace_dir, exist_ok=True)
+
+
+_NO_TRACE_DIR = object()
+
+
+def configure_from(config) -> bool:
+    """Configure from a FedConfig-shaped object; returns whether tracing is
+    now enabled. The one call every entry point (train()/run loops) makes —
+    the config's ``trace_dir`` is authoritative, so a run with it unset
+    DISABLES tracing left on by an earlier run in the same process (its
+    events would otherwise append into the previous run's trace files).
+    Only a config without the attribute at all leaves tracing untouched."""
+    trace_dir = getattr(config, "trace_dir", _NO_TRACE_DIR)
+    if trace_dir is _NO_TRACE_DIR:
+        return tracing_enabled()
+    if not trace_dir:
+        if tracing_enabled():
+            configure(None)
+        return False
+    configure(trace_dir,
+              buffer_events=getattr(config, "trace_buffer_events", 65536),
+              jax_bridge=bool(getattr(config, "profile_dir", None)))
+    return True
+
+
+def tracing_enabled() -> bool:
+    return _ENABLED
+
+
+def get_tracer(rank: int = 0) -> Tracer:
+    """The rank's tracer (created on first use), or the shared disabled
+    tracer while tracing is off."""
+    if not _ENABLED:
+        return _DISABLED
+    rank = int(rank)
+    with _lock:
+        tr = _TRACERS.get(rank)
+        if tr is None:
+            tr = _TRACERS[rank] = Tracer(rank, buffer_events=_BUFFER,
+                                         trace_id=_TRACE_ID)
+            if _JAX_BRIDGE:
+                try:
+                    import jax
+
+                    tr._jax_bridge = jax.profiler.TraceAnnotation
+                except Exception:  # pragma: no cover - jax always present here
+                    tr._jax_bridge = None
+        return tr
+
+
+def tracer_if_enabled(rank: int = 0) -> Optional[Tracer]:
+    """Hot-path gate: ``None`` while tracing is off — one global read, no
+    allocation — else the rank's tracer."""
+    if not _ENABLED:
+        return None
+    return get_tracer(rank)
+
+
+def flush_all(trace_dir: Optional[str] = None) -> list[str]:
+    """Flush every live tracer to ``<dir>/trace-rank<r>.jsonl`` (append),
+    including a per-rank counter snapshot from the default registry.
+    Returns the paths written."""
+    from fedml_tpu.obs.registry import default_registry
+
+    d = trace_dir or _TRACE_DIR
+    if not d:
+        return []
+    os.makedirs(d, exist_ok=True)
+    with _lock:
+        tracers = list(_TRACERS.values())
+    paths = []
+    for tr in tracers:
+        p = os.path.join(d, f"trace-rank{tr.rank}.jsonl")
+        if tr.flush(p, registry=default_registry()):
+            paths.append(p)
+    return paths
+
+
+def reset() -> None:
+    """Drop all tracers and disable tracing (tests; never mid-run)."""
+    global _ENABLED, _TRACE_DIR, _TRACE_ID
+    with _lock:
+        _ENABLED = False
+        _TRACE_DIR = None
+        _TRACE_ID = None
+        _TRACERS.clear()

@@ -15,6 +15,13 @@ This module provides:
   this environment has no wandb and no egress),
 - :func:`profile_trace` — context manager around ``jax.profiler.trace`` for
   TensorBoard-consumable device traces.
+
+Since the fedtrace PR these surfaces are VIEWS over the unified registry
+(fedml_tpu/obs, DESIGN.md §12): ``RoundTimer.sums`` is a ``CounterGroup``
+attached to the process registry's ``time`` namespace, phase blocks emit
+tracer spans when tracing is on, and ``wire_stats`` reads counter groups
+the reliable/chaos managers attach under ``wire``/``chaos``. Public
+signatures and metric key names are unchanged.
 """
 
 from __future__ import annotations
@@ -23,33 +30,51 @@ import contextlib
 import json
 import logging
 import time
-from collections import defaultdict
 from typing import Optional
 
 log = logging.getLogger(__name__)
 
 
 class RoundTimer:
-    """Accumulates per-phase seconds; `with timer.phase("train"): ...`."""
+    """Accumulates per-phase seconds; `with timer.phase("train"): ...`.
 
-    def __init__(self):
-        self.sums: dict[str, float] = defaultdict(float)
+    Phase sums live in a ``CounterGroup`` under the unified registry's
+    ``time`` namespace (``rank`` tags whose wall clock this is in a
+    multi-rank process); each phase block also opens a tracer span, so the
+    same instrumentation feeds the summary dict AND the trace timeline."""
+
+    def __init__(self, rank: int = 0):
+        from fedml_tpu.obs import default_registry
+
+        self.rank = int(rank)
+        self.sums = default_registry().group("time", rank=self.rank)
         self.rounds = 0
-        self._start = time.time()
+        # monotonic base: time.time() is NTP-step sensitive, and summary()
+        # divides phase sums measured on perf_counter by this wall — mixing
+        # clock domains made rounds_per_sec wrong across a clock step
+        self._start = time.perf_counter()
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        from fedml_tpu.obs import tracer_if_enabled
+
+        tr = tracer_if_enabled(self.rank)
         t0 = time.perf_counter()
         try:
-            yield
+            if tr is None:
+                yield
+            else:
+                with tr.span(name, cat="phase"):
+                    yield
         finally:
-            self.sums[name] += time.perf_counter() - t0
+            self.sums[name] = self.sums.get(name, 0.0) + (
+                time.perf_counter() - t0)
 
     def tick_round(self):
         self.rounds += 1
 
     def summary(self) -> dict:
-        wall = max(time.time() - self._start, 1e-9)
+        wall = max(time.perf_counter() - self._start, 1e-9)
         out = {f"time/{k}_s": round(v, 4) for k, v in self.sums.items()}
         out["time/wall_s"] = round(wall, 4)
         out["rounds_per_sec"] = round(self.rounds / wall, 4) if self.rounds else 0.0
@@ -90,7 +115,12 @@ class MetricsLogger:
 
     Names follow the reference exactly ('Train/Acc', 'Test/Acc', 'Test/Loss'
     keyed by 'round', fedavg_api.py:173-179; per-client 'Client.<id>' and
-    'GLOBAL' in the silo fork, silo_fedavg.py:126-127)."""
+    'GLOBAL' in the silo fork, silo_fedavg.py:126-127).
+
+    Usable as a context manager (the JSONL handle is guaranteed closed even
+    when the run raises); ``history_cap`` bounds the in-memory history like
+    the tracer's ring buffer — a weeks-long federation keeps the latest N
+    records instead of growing without bound."""
 
     def __init__(
         self,
@@ -98,8 +128,12 @@ class MetricsLogger:
         enable_wandb: bool = False,
         jsonl_path: Optional[str] = None,
         config: Optional[dict] = None,
+        history_cap: Optional[int] = None,
     ):
-        self.history: list[dict] = []
+        from collections import deque
+
+        self.history = (deque(maxlen=int(history_cap)) if history_cap
+                        else [])
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self._wandb = None
         if enable_wandb:
@@ -123,6 +157,21 @@ class MetricsLogger:
             self._wandb.log(rec)
         log.info("metrics %s", rec)
 
+    def log_registry(self, registry=None, round_idx: Optional[int] = None,
+                     namespace: Optional[str] = None):
+        """Log a snapshot of the unified registry (fedml_tpu/obs) — wire
+        counters, phase sums, chaos stats — as one record, flat-keyed
+        ``<namespace>/<counter>`` exactly like ``wire_stats``."""
+        from fedml_tpu.obs import default_registry
+
+        reg = registry if registry is not None else default_registry()
+        snap = reg.snapshot(namespace)
+        if namespace is not None:
+            snap = {f"{namespace}/{k}": v for k, v in snap.items()}
+        if snap:
+            self.log(snap, round_idx)
+        return snap
+
     def last(self, key: str):
         for rec in reversed(self.history):
             if key in rec:
@@ -135,8 +184,28 @@ class MetricsLogger:
     def close(self):
         if self._jsonl:
             self._jsonl.close()
+            self._jsonl = None
         if self._wandb:
             self._wandb.finish()
+            self._wandb = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        # last-resort handle close for callers that never reach close()
+        # (an exception between construction and the finally); harmless
+        # after an explicit close
+        jsonl = getattr(self, "_jsonl", None)
+        if jsonl is not None:
+            try:
+                jsonl.close()
+            except Exception:
+                pass
 
 
 def wire_stats(comm) -> dict:

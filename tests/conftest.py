@@ -23,6 +23,20 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# Persistent XLA compilation cache: the suite re-instantiates identical models
+# across API objects and test files (each instance re-traces, so the in-memory
+# jit cache never shares), and on the 2-vCPU CI box compilation dominates the
+# tier-1 wall clock. Keyed by HLO hash, so a hit returns the same executable —
+# numerics are unaffected. Set FEDML_TPU_NO_COMPILE_CACHE=1 to disable.
+if not os.environ.get("FEDML_TPU_NO_COMPILE_CACHE"):
+    _cache_dir = os.environ.get(
+        "FEDML_TPU_COMPILE_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -69,12 +69,12 @@ def test_resnet_pallas_bn_matches_xla_bn_end_to_end():
     vars_x = _rename(vars_p, "PallasBatchNorm", "BatchNorm")
 
     rng = np.random.default_rng(1)
-    x = jnp.asarray(rng.normal(size=(8, 32, 32, 3)).astype(np.float32))
-    y = jnp.asarray(rng.integers(0, 10, size=(8,)))
+    x = jnp.asarray(rng.normal(size=(4, 16, 16, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, size=(4,)))
 
     def loss(bundle, variables, x, y, dk):
         logits, new_vars = bundle.apply_train(variables, x, dk)
-        l = -jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(8), y])
+        l = -jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(4), y])
         return l, new_vars
 
     dk = jax.random.PRNGKey(2)
@@ -106,10 +106,10 @@ def test_resnet_pallas_bn_trains():
     from fedml_tpu.data.synthetic import make_synthetic_classification
 
     ds = make_synthetic_classification(
-        "pbn", (16, 16, 3), 4, 4, records_per_client=32,
+        "pbn", (16, 16, 3), 4, 2, records_per_client=16,
         partition_method="homo", batch_size=16, seed=0)
-    cfg = FedConfig(model="resnet20", dataset="pbn", client_num_in_total=4,
-                    client_num_per_round=4, comm_round=2, batch_size=16,
+    cfg = FedConfig(model="resnet20", dataset="pbn", client_num_in_total=2,
+                    client_num_per_round=2, comm_round=1, batch_size=16,
                     lr=0.05, frequency_of_the_test=1, seed=0,
                     device_data="off")
     bundle = create_model("resnet20", 4, input_shape=(16, 16, 3),

@@ -91,11 +91,12 @@ def test_resnet_lanes_model_parity():
     """Same params -> same logits / grads / batch stats (float-order
     tolerance: the kernel sums taps in a different association, which
     compounds through 20 layers)."""
-    std = create_model("resnet20", 10)
-    lan = create_model("resnet20", 10, conv_impl="lanes")
-    v = std.init(jax.random.PRNGKey(0), batch_size=4)
-    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
-    labels = jnp.array([0, 1, 2, 3])
+    std = create_model("resnet20", 10, input_shape=(16, 16, 3))
+    lan = create_model("resnet20", 10, input_shape=(16, 16, 3),
+                       conv_impl="lanes")
+    v = std.init(jax.random.PRNGKey(0), batch_size=2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+    labels = jnp.array([0, 1])
 
     e1, e2 = std.apply_eval(v, x), lan.apply_eval(v, x)
     np.testing.assert_allclose(e1, e2, rtol=0, atol=5e-3)
@@ -127,7 +128,7 @@ def test_lanes_rides_fedavg_round():
     from fedml_tpu.data.synthetic import make_synthetic_classification
 
     ds = make_synthetic_classification(
-        "lanes-round", (32, 32, 3), 10, 4, records_per_client=8,
+        "lanes-round", (16, 16, 3), 10, 4, records_per_client=8,
         partition_method="homo", batch_size=4, seed=0)
     cfg = FedConfig(model="resnet20", dataset="cifar10",
                     client_num_in_total=4, client_num_per_round=2,

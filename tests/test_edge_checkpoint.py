@@ -17,8 +17,8 @@ from fedml_tpu.data import load_dataset
 from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
 
 WORKERS = 3
-ROUNDS = 6
-CUT = 3   # checkpoint boundary where the "kill" happens
+ROUNDS = 4
+CUT = 2   # checkpoint boundary where the "kill" happens
 
 
 def _cfg(**kw):

@@ -51,7 +51,7 @@ class RecordingServer(FedAvgEdgeServerManager):
     """Records per-round worker→clients assignments for assertions."""
 
     # keep the all-dead rejoin wait short in tests (production default 10)
-    _MAX_EMPTY_DEADLINES = 4
+    _MAX_EMPTY_DEADLINES = 3
 
     def _broadcast_model(self, msg_type, global_params, assignments):
         if not hasattr(self, "assignment_log"):
@@ -131,8 +131,8 @@ class DroppingClient(FedAvgEdgeClientManager):
 
 def test_ft_healthy_run_is_bit_identical_to_strict():
     ds = _ds()
-    strict = run_fedavg_edge(ds, _cfg(), worker_num=WORKERS)
-    ft = run_fedavg_edge(ds, _cfg(straggler_deadline_sec=60.0),
+    strict = run_fedavg_edge(ds, _cfg(comm_round=3), worker_num=WORKERS)
+    ft = run_fedavg_edge(ds, _cfg(comm_round=3, straggler_deadline_sec=60.0),
                          worker_num=WORKERS)
     assert [h["acc"] for h in ft.test_history] == \
            [h["acc"] for h in strict.test_history]
@@ -194,7 +194,7 @@ def test_worker_rejoin_reenters_federation():
     # stalls the federation until the JOINs arrive, so no flakiness
     cfg = _cfg(straggler_deadline_sec=6.0, comm_round=6)
     managers = _run(ds, cfg, client_cls=DroppingClient,
-                    client_kw=dict(drop_round=1, rejoin_after=10.0),
+                    client_kw=dict(drop_round=1, rejoin_after=8.0),
                     timeout=150.0)
     server = managers[0]
     hist = server.aggregator.test_history

@@ -73,7 +73,7 @@ class TestTAThreshold:
         healthy = te.run_turboaggregate_edge(
             ds, _cfg(straggler_deadline_sec=60.0), threshold_t=1)
         monkeypatch.setattr(te, "TAThresholdClientManager", DiesAfterDealing)
-        cfg = _cfg(straggler_deadline_sec=12.0)
+        cfg = _cfg(straggler_deadline_sec=8.0)
         server = te.run_turboaggregate_edge(ds, cfg, threshold_t=1)
         # rounds 0..1 closed with full data (round 1's D includes the dead
         # clients — they dealt before dying)
@@ -103,7 +103,7 @@ class TestTAThreshold:
 
         monkeypatch.setattr(te, "TAThresholdClientManager", NeverDeals)
         server = te.run_turboaggregate_edge(
-            _ds(), _cfg(straggler_deadline_sec=12.0), threshold_t=1)
+            _ds(), _cfg(straggler_deadline_sec=8.0), threshold_t=1)
         assert server._alive[3] is False
         assert server.history["round"] == [0, 1, 2]
         assert all(np.isfinite(l) for l in server.history["Test/Loss"])
@@ -121,7 +121,7 @@ class TestTAThreshold:
 
         monkeypatch.setattr(te, "TAThresholdClientManager", DiesAfterDealing)
         server = te.run_turboaggregate_edge(
-            _ds(), _cfg(straggler_deadline_sec=12.0), threshold_t=1,
+            _ds(), _cfg(straggler_deadline_sec=8.0), threshold_t=1,
             comm_factory=lambda r: GRPCCommManager(rank=r, size=C + 1,
                                                    base_port=56870))
         assert server._alive[1] is False
@@ -162,7 +162,7 @@ class TestSplitNNManagedRing:
         monkeypatch.setattr(se, "SplitNNEdgeClientManager", Silent)
         ds, _, cb, sb = self._setup()
         cfg = FedConfig(batch_size=10, lr=0.1, momentum=0.9, epochs=2,
-                        seed=0, straggler_deadline_sec=10.0)
+                        seed=0, straggler_deadline_sec=6.0)
         server = se.run_splitnn_edge(ds, cfg, cb, sb)
         # 2 live clients x 2 epochs of validation each
         assert len(server.val_history) == 4
@@ -182,7 +182,7 @@ class TestSplitNNManagedRing:
         monkeypatch.setattr(se, "SplitNNEdgeClientManager", Silent)
         ds, _, cb, sb = self._setup()
         cfg = FedConfig(batch_size=10, lr=0.1, momentum=0.9, epochs=1,
-                        seed=0, straggler_deadline_sec=10.0)
+                        seed=0, straggler_deadline_sec=6.0)
         server = se.run_splitnn_edge(
             ds, cfg, cb, sb,
             comm_factory=lambda r: GRPCCommManager(rank=r, size=4,

@@ -127,7 +127,7 @@ class TestProfileDir:
     def test_profile_dir_writes_trace(self, tmp_path):
         ds = _ds()
         d = str(tmp_path / "trace")
-        api = FedAvgAPI(ds, _cfg(comm_round=2, profile_dir=d))
+        api = FedAvgAPI(ds, _cfg(comm_round=1, profile_dir=d))
         api.train()
         # jax profiler writes plugins/profile/<ts>/*.xplane.pb under the dir
         found = []

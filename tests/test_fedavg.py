@@ -59,7 +59,7 @@ class TestEquivalence:
         n_pad = ds.train_x.shape[1]
         fed_cfg = FedConfig(
             model="cnn", dataset="convq", client_num_in_total=4,
-            client_num_per_round=4, comm_round=3, epochs=1,
+            client_num_per_round=4, comm_round=2, epochs=1,
             batch_size=n_pad, lr=0.2, frequency_of_the_test=10, seed=5,
         )
         fed = FedAvgAPI(ds, fed_cfg,
@@ -382,9 +382,9 @@ class TestBucketGroups:
 
     def test_grouped_deterministic(self):
         ds = self._ragged_ds()
-        r1 = FedAvgAPI(ds, self._cfg(bucket_groups=3, comm_round=6),
+        r1 = FedAvgAPI(ds, self._cfg(bucket_groups=3, comm_round=4),
                        create_model("lr", 3, input_shape=(6,))).train()
-        r2 = FedAvgAPI(ds, self._cfg(bucket_groups=3, comm_round=6),
+        r2 = FedAvgAPI(ds, self._cfg(bucket_groups=3, comm_round=4),
                        create_model("lr", 3, input_shape=(6,))).train()
         assert r1["Test/Acc"] == r2["Test/Acc"]
         assert r1["Test/Loss"] == r2["Test/Loss"]

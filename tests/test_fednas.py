@@ -53,9 +53,11 @@ def test_fednas_search_round():
         "nas", (8, 8, 3), 3, 4, records_per_client=8,
         partition_method="homo", batch_size=4, seed=0,
     )
+    # lr matches test_fednas_unrolled_search_round's single-level run so the
+    # two compile to the same HLO (persistent compilation cache shares it)
     cfg = FedConfig(
         model="lr", client_num_in_total=4, client_num_per_round=4,
-        comm_round=2, epochs=1, batch_size=4, lr=0.01, seed=1,
+        comm_round=2, epochs=1, batch_size=4, lr=0.05, seed=1,
         frequency_of_the_test=1,
     )
     api = FedNASAPI(ds, cfg, channels=4, layers=2, steps=2, multiplier=2)
@@ -78,7 +80,7 @@ def test_fednas_unrolled_search_round():
         partition_method="homo", batch_size=4, seed=0,
     )
     kw = dict(model="lr", client_num_in_total=4, client_num_per_round=4,
-              comm_round=2, epochs=1, batch_size=4, lr=0.05, seed=1,
+              comm_round=1, epochs=1, batch_size=4, lr=0.05, seed=1,
               frequency_of_the_test=1)
     size = dict(channels=4, layers=2, steps=2, multiplier=2)
     api_u = FedNASAPI(ds, FedConfig(unrolled=1, **kw), **size)

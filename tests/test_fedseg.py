@@ -68,7 +68,7 @@ def test_fedseg_learns():
     )
     cfg = FedConfig(
         model="unet", dataset="synthetic_seg", client_num_in_total=4,
-        client_num_per_round=4, comm_round=10, epochs=2, batch_size=4,
+        client_num_per_round=4, comm_round=8, epochs=2, batch_size=4,
         lr=0.1, momentum=0.9, seed=1, frequency_of_the_test=5,
     )
     api = FedSegAPI(ds, cfg, create_model("unet", 3, input_shape=(16, 16, 3)))

@@ -30,7 +30,7 @@ def _ds():
 def _cfg(**kw):
     base = dict(
         model="lr", dataset="synthetic", client_num_in_total=C,
-        client_num_per_round=C, comm_round=4, epochs=1, epochs_server=1,
+        client_num_per_round=C, comm_round=3, epochs=1, epochs_server=1,
         batch_size=4, lr=0.05, seed=5, frequency_of_the_test=1,
     )
     base.update(kw)
@@ -68,7 +68,7 @@ def test_gkt_straggler_dropped_run_completes(monkeypatch):
     ds = _ds()
     server = _run(ds, _cfg(straggler_deadline_sec=8.0), Silent, monkeypatch)
     hist = server.history
-    assert [h["round"] for h in hist] == list(range(4))
+    assert [h["round"] for h in hist] == list(range(3))
     assert all(np.isfinite(h["Test/Loss"]) for h in hist)
     assert server._alive == {0: True, 1: True, 2: False}
 
@@ -88,7 +88,7 @@ def test_gkt_client_dead_from_round_zero(monkeypatch):
     server = _run(ds, _cfg(straggler_deadline_sec=8.0), DeadFromStart,
                   monkeypatch)
     hist = server.history
-    assert [h["round"] for h in hist] == list(range(4))
+    assert [h["round"] for h in hist] == list(range(3))
     assert all(np.isfinite(h["Test/Loss"]) for h in hist)
     assert server._alive[2] is False
 
@@ -102,16 +102,16 @@ def test_gkt_late_straggler_rejoins(monkeypatch):
     class Slow(fe.GKTEdgeClientManager):
         def _on_sync(self, msg):
             if int(msg.get(fe.KEY_ROUND)) == 1:
-                time.sleep(16.0)   # well past the deadline
+                time.sleep(12.0)   # well past the deadline
             super()._on_sync(msg)
 
     ds = _ds()
     # deadline must clear round 0's jit compile; the sleep must clear the
     # deadline with margin
-    server = _run(ds, _cfg(straggler_deadline_sec=8.0, comm_round=5),
+    server = _run(ds, _cfg(straggler_deadline_sec=8.0, comm_round=4),
                   Slow, monkeypatch)
     hist = server.history
-    assert [h["round"] for h in hist] == list(range(5))
+    assert [h["round"] for h in hist] == list(range(4))
     assert server._alive == {0: True, 1: True, 2: True}   # rejoined
     assert all(np.isfinite(h["Test/Loss"]) for h in hist)
 
@@ -148,19 +148,19 @@ def test_gkt_edge_kill_and_resume_bit_identical(tmp_path):
     run's history — the same standard test_edge_checkpoint.py pins for
     FedAvg."""
     ds = _ds()
-    full = _run(ds, _cfg(comm_round=6))
+    full = _run(ds, _cfg(comm_round=4))
 
     ckpt_dir = str(tmp_path / "gkt_ckpt")
-    _run(ds, _cfg(comm_round=3, checkpoint_dir=ckpt_dir,
-                  checkpoint_frequency=3))
+    _run(ds, _cfg(comm_round=2, checkpoint_dir=ckpt_dir,
+                  checkpoint_frequency=2))
     import os
 
     ckpt = os.path.join(ckpt_dir, "gkt_server.ckpt")
     assert os.path.exists(ckpt)
     assert os.path.exists(os.path.join(ckpt_dir, "gkt_client_0.state"))
 
-    resumed = _run(ds, _cfg(comm_round=6, checkpoint_dir=ckpt_dir,
-                            checkpoint_frequency=3, resume_from=ckpt))
+    resumed = _run(ds, _cfg(comm_round=4, checkpoint_dir=ckpt_dir,
+                            checkpoint_frequency=2, resume_from=ckpt))
     assert [h["round"] for h in resumed.history] == \
            [h["round"] for h in full.history]
     np.testing.assert_array_equal(
@@ -173,7 +173,7 @@ def test_gkt_edge_kill_and_resume_bit_identical(tmp_path):
     # resume WITHOUT --checkpoint_dir: the client state is found next to
     # the server checkpoint, so the result is STILL bit-identical (a
     # silent client restart-from-init would diverge here)
-    resumed2 = _run(ds, _cfg(comm_round=6, checkpoint_frequency=3,
+    resumed2 = _run(ds, _cfg(comm_round=4, checkpoint_frequency=2,
                              resume_from=ckpt))
     np.testing.assert_array_equal(
         [h["Test/Acc"] for h in resumed2.history],

@@ -8,18 +8,18 @@ from fedml_tpu.models import create_model
 
 
 def test_efficientnet_forward_and_train_mode():
-    b = create_model("efficientnet-b0", 10, input_shape=(32, 32, 3))
+    b = create_model("efficientnet-b0", 10, input_shape=(16, 16, 3))
     v = b.init(jax.random.PRNGKey(0))
-    out = b.apply_eval(v, jnp.zeros((2, 32, 32, 3)))
+    out = b.apply_eval(v, jnp.zeros((2, 16, 16, 3)))
     assert out.shape == (2, 10)
-    logits, new_vars = b.apply_train(v, jnp.zeros((2, 32, 32, 3)), jax.random.PRNGKey(1))
+    logits, new_vars = b.apply_train(v, jnp.zeros((2, 16, 16, 3)), jax.random.PRNGKey(1))
     assert logits.shape == (2, 10) and "batch_stats" in new_vars
     assert np.all(np.isfinite(np.asarray(logits)))
 
 
 def test_efficientnet_scaling_widths():
-    b0 = create_model("efficientnet-b0", 10)
-    b2 = create_model("efficientnet-b2", 10)
+    b0 = create_model("efficientnet-b0", 10, input_shape=(16, 16, 3))
+    b2 = create_model("efficientnet-b2", 10, input_shape=(16, 16, 3))
     v0 = b0.init(jax.random.PRNGKey(0))
     v2 = b2.init(jax.random.PRNGKey(0))
     n0 = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(v0["params"]))

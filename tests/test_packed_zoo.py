@@ -19,7 +19,7 @@ C = 16
 
 def _ds():
     return make_synthetic_classification(
-        "pzoo", (6,), 4, C, records_per_client=200,
+        "pzoo", (6,), 4, C, records_per_client=100,
         partition_method="hetero", partition_alpha=0.3, batch_size=8, seed=21,
     )
 
@@ -107,7 +107,7 @@ def test_packed_fedseg_matches_sim():
     from fedml_tpu.data.segmentation import make_synthetic_segmentation
 
     ds = make_synthetic_segmentation(
-        num_clients=16, records_per_client=12, image_size=16, num_classes=3,
+        num_clients=16, records_per_client=8, image_size=16, num_classes=3,
         batch_size=4, seed=7)
     kw = dict(model="unet", dataset="seg", client_num_in_total=16,
               client_num_per_round=16, comm_round=2, batch_size=4, lr=0.1,

@@ -1,0 +1,422 @@
+"""fedtrace (fedml_tpu/obs): span tracing, registry unification, exporters,
+and the trace_report analyzer (ISSUE 4 acceptance surface).
+
+Pinned contracts:
+- a traced run is bit-identical to an untraced run (the tracer only reads
+  clocks);
+- per-rank trace files stitch into ONE causal timeline: every round present
+  on every rank, every recv span linked to its send span by message uid —
+  over the local AND grpc transports;
+- the disabled path allocates nothing (tracing off is free);
+- exporter round-trip preserves events; the Chrome export draws flow arrows;
+- tools/trace_report.py exits non-zero exactly on structural anomalies.
+"""
+
+import gc
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu import obs
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data import load_dataset
+from fedml_tpu.data.synthetic import make_synthetic_classification
+from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(REPO, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _reset_tracing():
+    """Tracing state is process-global; never leak it across tests."""
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _edge_cfg(**kw):
+    base = dict(
+        model="lr", dataset="synthetic_1_1", client_num_in_total=4,
+        client_num_per_round=4, comm_round=2, batch_size=10, lr=0.1,
+        epochs=1, frequency_of_the_test=1, seed=3, device_data="off",
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _edge_ds():
+    return load_dataset("synthetic_1_1", num_clients=4, batch_size=10, seed=3)
+
+
+# -- bit-identity: tracing must not touch the math -------------------------
+
+def test_traced_fedavg_run_bit_identical(tmp_path):
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    def run(trace_dir):
+        obs.reset()
+        ds = make_synthetic_classification(
+            "tr", (6,), 3, 4, records_per_client=8,
+            partition_method="homo", batch_size=4, seed=0)
+        cfg = FedConfig(model="lr", client_num_in_total=4,
+                        client_num_per_round=4, comm_round=2, batch_size=4,
+                        lr=0.1, frequency_of_the_test=1, trace_dir=trace_dir)
+        api = FedAvgAPI(ds, cfg)
+        hist = api.train()
+        return hist, api
+
+    traced_hist, traced_api = run(str(tmp_path / "traces"))
+    plain_hist, plain_api = run(None)
+    assert traced_hist["Test/Acc"] == plain_hist["Test/Acc"]
+    assert traced_hist["Test/Loss"] == plain_hist["Test/Loss"]
+    for a, b in zip(jax.tree.leaves(traced_api.variables),
+                    jax.tree.leaves(plain_api.variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the traced run actually produced a trace with its rounds
+    path = tmp_path / "traces" / "trace-rank0.jsonl"
+    assert path.exists()
+    events = [json.loads(l) for l in open(path)]
+    rounds = {e["args"]["round"] for e in events
+              if e.get("name") == "round" and e.get("ph") == "X"}
+    assert rounds == {0, 1}
+    phases = {e["name"] for e in events if e.get("cat") == "phase"}
+    assert "train" in phases and "eval" in phases
+
+
+# -- cross-rank stitch: local + grpc ---------------------------------------
+
+def _assert_stitched(trace_dir, n_ranks, n_rounds):
+    tr = _load_trace_report()
+    events = tr.load_trace_dir(str(trace_dir))
+    rep = tr.analyze(events, expect_ranks=n_ranks)
+    assert rep["anomalies"] == []
+    assert rep["ranks"] == list(range(n_ranks))
+    assert rep["rounds"] == n_rounds
+    for entry in rep["timeline"]:
+        assert entry["ranks"] == list(range(n_ranks))   # every rank, every round
+        assert "critical_path" in entry                  # chain fully linked
+        assert entry["critical_path"]["train_ms"] >= 0
+    # message-id causality: every recv in the merged trace has its send
+    sends = {e["args"]["mid"] for e in events
+             if e.get("name") == "send" and e.get("ph") == "X"}
+    recvs = {e["args"]["mid"] for e in events
+             if e.get("name") == "recv" and e.get("ph") == "X"}
+    assert recvs and recvs <= sends
+    return rep
+
+
+def test_cross_rank_stitch_local(tmp_path):
+    d = str(tmp_path / "tr")
+    run_fedavg_edge(_edge_ds(), _edge_cfg(trace_dir=d), worker_num=2)
+    rep = _assert_stitched(d, n_ranks=3, n_rounds=2)
+    assert rep["straggler_ranking"]   # workers ranked
+
+
+def test_cross_rank_stitch_grpc_4_ranks(tmp_path):
+    """The acceptance run: a 4-rank grpc fedavg federation with --trace_dir
+    set produces per-rank files that merge into one causally-stitched
+    timeline — every round on every rank, sends linked to recvs by uid."""
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    d = str(tmp_path / "tr")
+    run_fedavg_edge(
+        _edge_ds(), _edge_cfg(trace_dir=d), worker_num=3,
+        comm_factory=lambda r: GRPCCommManager(
+            rank=r, size=4, base_port=56880, host="127.0.0.1"))
+    assert sorted(os.listdir(d)) == [f"trace-rank{r}.jsonl" for r in range(4)]
+    _assert_stitched(d, n_ranks=4, n_rounds=2)
+
+
+def test_retransmits_tagged_with_message_uid(tmp_path):
+    """Chaos drops force retransmits; the retransmit instants carry the SAME
+    uid as the original send span, so the analyzer collapses the storm onto
+    one logical edge and still stitches every round."""
+    d = str(tmp_path / "tr")
+    cfg = _edge_cfg(trace_dir=d, wire_reliable=True, chaos_drop=0.2,
+                    chaos_seed=7)
+    run_fedavg_edge(_edge_ds(), cfg, worker_num=2)
+    rep = _assert_stitched(d, n_ranks=3, n_rounds=2)
+    assert rep["wire"]["chaos/dropped"] > 0
+    assert rep["wire"]["retransmit_instants"] > 0
+    events = _load_trace_report().load_trace_dir(d)
+    send_mids = {e["args"]["mid"] for e in events if e.get("name") == "send"}
+    retx_mids = {e["args"]["mid"] for e in events
+                 if e.get("name") == "retransmit" and "mid" in e.get("args", {})}
+    assert retx_mids and retx_mids <= send_mids
+
+
+# -- exporters -------------------------------------------------------------
+
+GOLDEN_EVENTS = [
+    {"ph": "X", "name": "round", "cat": "round", "ts": 1000, "rank": 0,
+     "tid": 1, "dur": 500, "sid": 1, "args": {"round": 0, "role": "server"}},
+    {"ph": "X", "name": "send", "cat": "comm", "ts": 1010, "rank": 0,
+     "tid": 1, "dur": 5, "sid": 2, "psid": 1,
+     "args": {"msg_type": "2", "peer": 1, "mid": "abcdef0123456789"}},
+    {"ph": "X", "name": "recv", "cat": "comm", "ts": 1100, "rank": 1,
+     "tid": 2, "dur": 300, "sid": 1,
+     "args": {"msg_type": "2", "peer": 0, "mid": "abcdef0123456789"}},
+    {"ph": "i", "name": "retransmit", "cat": "wire", "ts": 1050, "rank": 0,
+     "tid": 1, "args": {"peer": 1, "attempt": 1}},
+    {"ph": "C", "name": "host_stages", "cat": "counter", "ts": 1400,
+     "rank": 0, "tid": 1,
+     "args": {"round": 0, "values": {"materialize_ms": 2.5, "wait_ms": 0.5}}},
+]
+
+
+def test_exporter_jsonl_roundtrip(tmp_path):
+    from fedml_tpu.obs.export import read_jsonl, write_jsonl
+
+    p = str(tmp_path / "golden.jsonl")
+    write_jsonl(p, GOLDEN_EVENTS)
+    assert read_jsonl(p) == GOLDEN_EVENTS
+
+
+def test_exporter_chrome_trace_golden(tmp_path):
+    from fedml_tpu.obs.export import read_jsonl, to_chrome_trace, write_chrome_trace
+
+    out = to_chrome_trace(GOLDEN_EVENTS)
+    evs = out["traceEvents"]
+    # per-rank process metadata
+    proc = {e["pid"]: e["args"]["name"] for e in evs
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert proc == {0: "rank 0", 1: "rank 1"}
+    # spans keep rank->pid, ts, dur
+    span = next(e for e in evs if e["ph"] == "X" and e["name"] == "round")
+    assert (span["pid"], span["ts"], span["dur"]) == (0, 1000, 500)
+    # counters flatten to numeric args
+    ctr = next(e for e in evs if e["ph"] == "C")
+    assert ctr["args"] == {"materialize_ms": 2.5, "wait_ms": 0.5}
+    # the send/recv pair becomes a flow arrow from rank 0 to rank 1
+    fs = next(e for e in evs if e["ph"] == "s")
+    ff = next(e for e in evs if e["ph"] == "f")
+    assert fs["pid"] == 0 and ff["pid"] == 1 and fs["id"] == ff["id"]
+    # file writer emits the same structure
+    p = str(tmp_path / "chrome.json")
+    write_chrome_trace(p, GOLDEN_EVENTS)
+    assert json.load(open(p))["traceEvents"] == evs
+    assert read_jsonl  # imported for parity; silence linters
+
+
+# -- disabled-path overhead ------------------------------------------------
+
+def test_disabled_path_allocates_nothing():
+    """tracing off: the hot-path gate returns None from one global read and
+    span() on the shared disabled tracer returns a singleton — no per-call
+    allocations survive."""
+    import tracemalloc
+
+    assert obs.tracer_if_enabled(0) is None
+    tr = obs.get_tracer(0)
+    assert tr.span("x") is tr.span("y")   # the shared no-op singleton
+    gc.collect()
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(2000):
+        t = obs.tracer_if_enabled(3)
+        if t is not None:                  # never taken: tracing is off
+            with t.span("hot"):
+                pass
+        with tr.span("hot"):
+            pass
+        tr.instant("i")
+        tr.counter("c", 1.0)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    growth = sum(s.size_diff for s in after.compare_to(before, "lineno")
+                 if s.size_diff > 0)
+    # tracemalloc's own bookkeeping costs a few KiB; 2000 traced spans would
+    # cost hundreds of KiB of event dicts
+    assert growth < 64_000, f"disabled tracing leaked {growth} bytes"
+
+
+# -- trace_report anomaly exit codes ---------------------------------------
+
+def _write_trace(tmp_path, name, events):
+    d = tmp_path / name
+    d.mkdir()
+    by_rank = {}
+    for e in events:
+        by_rank.setdefault(e.get("rank", 0), []).append(e)
+    for r, evs in by_rank.items():
+        with open(d / f"trace-rank{r}.jsonl", "w") as f:
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+    return str(d)
+
+
+def test_trace_report_exit_codes(tmp_path, capsys):
+    tr = _load_trace_report()
+    clean = _write_trace(tmp_path, "clean", [
+        {"ph": "X", "name": "round", "cat": "round", "ts": 10, "rank": 0,
+         "dur": 5, "sid": 1, "args": {"round": 0}},
+        {"ph": "X", "name": "round", "cat": "round", "ts": 11, "rank": 1,
+         "dur": 5, "sid": 1, "args": {"round": 0}},
+    ])
+    assert tr.main([clean]) == 0
+
+    # empty dir: nothing to analyze
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert tr.main([str(empty)]) == 2
+
+    # unclosed span -> anomaly
+    unclosed = _write_trace(tmp_path, "unclosed", [
+        {"ph": "X", "name": "round", "cat": "round", "ts": 10, "rank": 0,
+         "dur": 5, "sid": 1, "args": {"round": 0}},
+        {"ph": "O", "name": "round", "cat": "round", "ts": 20, "rank": 0,
+         "sid": 2, "args": {"round": 1}},
+    ])
+    assert tr.main([unclosed]) == 1
+
+    # a round missing on one rank -> anomaly
+    missing = _write_trace(tmp_path, "missing", [
+        {"ph": "X", "name": "round", "cat": "round", "ts": 10, "rank": 0,
+         "dur": 5, "sid": 1, "args": {"round": 0}},
+        {"ph": "X", "name": "round", "cat": "round", "ts": 11, "rank": 1,
+         "dur": 5, "sid": 1, "args": {"round": 0}},
+        {"ph": "X", "name": "round", "cat": "round", "ts": 30, "rank": 0,
+         "dur": 5, "sid": 2, "args": {"round": 1}},
+    ])
+    assert tr.main([missing]) == 1
+
+    # recv with no matching send (span imbalance) -> anomaly
+    orphan = _write_trace(tmp_path, "orphan", [
+        {"ph": "X", "name": "round", "cat": "round", "ts": 10, "rank": 0,
+         "dur": 5, "sid": 1, "args": {"round": 0}},
+        {"ph": "X", "name": "recv", "cat": "comm", "ts": 12, "rank": 0,
+         "dur": 1, "sid": 2, "args": {"mid": "beef", "peer": 1}},
+    ])
+    assert tr.main([orphan]) == 1
+
+    # fewer ranks than expected -> anomaly
+    assert tr.main([clean, "--expect-ranks", "4"]) == 1
+    capsys.readouterr()
+
+
+def test_trace_report_cli_smoke(tmp_path):
+    """The actual CLI entry point (subprocess) agrees with main()."""
+    import subprocess
+
+    d = _write_trace(tmp_path, "cli", [
+        {"ph": "X", "name": "round", "cat": "round", "ts": 10, "rank": 0,
+         "dur": 5, "sid": 1, "args": {"round": 0}},
+    ])
+    out = str(tmp_path / "perfetto.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         d, "--json", "--perfetto", out],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["rounds"] == 1 and rep["anomalies"] == []
+    assert json.load(open(out))["traceEvents"]
+
+
+# -- registry unification --------------------------------------------------
+
+def test_wire_counters_visible_through_registry():
+    """The reliable layer's stats dict IS a registry group now: the same
+    counters are readable per-manager (exact legacy surface) and through
+    one registry snapshot, without the manager in hand."""
+    from fedml_tpu.comm.local import LocalCommunicationManager, LocalRouter
+    from fedml_tpu.comm.reliable import ReliableCommManager
+    from fedml_tpu.obs import default_registry
+
+    before = default_registry().snapshot("wire").get("sent", 0)
+    router = LocalRouter(2)
+    rel = ReliableCommManager(
+        LocalCommunicationManager(router, 0, wire_roundtrip=True), rank=0)
+    from fedml_tpu.comm import Message
+
+    m = Message("data", 0, 1)
+    m.add_params("i", 1)
+    rel.send_message(m)
+    assert rel.stats["sent"] == 1                      # legacy view
+    assert default_registry().snapshot("wire")["sent"] >= before + 1
+    rel.stop_receive_message()
+
+
+def test_round_timer_feeds_registry_and_monotonic_wall():
+    from fedml_tpu.obs import default_registry
+    from fedml_tpu.utils.metrics import RoundTimer
+
+    import time
+
+    t = RoundTimer()
+    with t.phase("train"):
+        time.sleep(0.002)
+    t.tick_round()
+    s = t.summary()
+    assert "time/train_s" in s and s["time/wall_s"] > 0
+    assert s["rounds_per_sec"] > 0
+    # the phase sum is the SAME number the registry sees (a view, not a copy)
+    assert default_registry().snapshot("time", rank=0)["train"] >= \
+        t.sums["train"]
+
+
+def test_metrics_logger_cap_context_manager_and_registry_source(tmp_path):
+    from fedml_tpu.obs import default_registry
+    from fedml_tpu.utils.metrics import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(jsonl_path=path, history_cap=3) as ml:
+        for i in range(10):
+            ml.log({"Test/Acc": i / 10}, i)
+        assert len(ml.history) == 3                      # capped like the ring
+        assert ml.last("Test/Acc") == 0.9                # newest survives
+        g = default_registry().group("smoke_ns", keys=("hits",))
+        g["hits"] += 5
+        rec = ml.log_registry(namespace="smoke_ns")
+        assert rec == {"smoke_ns/hits": 5}
+    assert ml._jsonl is None                             # context exit closed it
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 11                              # JSONL keeps everything
+
+
+def test_stage_rows_recorded_in_registry():
+    """The host-path stage rows that feed round_stats are also recorded in
+    the registry's row store — same numbers, one unified surface."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.obs import default_registry
+    from fedml_tpu.utils.metrics import round_stats
+
+    default_registry().clear_rows("stage")
+    ds = make_synthetic_classification(
+        "rows", (6,), 3, 4, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=0)
+    cfg = FedConfig(model="lr", client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, batch_size=4, lr=0.1, device_data="off",
+                    frequency_of_the_test=1)
+    api = FedAvgAPI(ds, cfg)
+    for r in range(2):
+        api.run_round(r)
+    rows = default_registry().rows("stage")
+    assert [r["round"] for r in rows] == [0, 1]
+    assert round_stats(rows)["rounds"] == round_stats(api._stage_rows)["rounds"]
+    np.testing.assert_allclose(
+        round_stats(rows)["materialize_ms"],
+        round_stats(api._stage_rows)["materialize_ms"])
+    default_registry().clear_rows("stage")
+
+
+def test_trace_flags_validated():
+    with pytest.raises(ValueError):
+        FedConfig(trace_buffer_events=0)
+    c = FedConfig(trace_dir="/tmp/x", trace_buffer_events=128)
+    assert c.trace_dir == "/tmp/x"

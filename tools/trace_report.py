@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""trace_report: merge per-rank fedtrace files into one cross-rank round
+timeline and analyze it.
+
+Input: a ``--trace_dir`` directory of ``trace-rank<r>.jsonl`` files (one
+per rank, written by fedml_tpu/obs — in-process federations write all of
+them from one process; the per-rank gRPC deployment writes one per
+process; copy them into one directory to analyze a real multi-host run).
+
+The analyzer reconstructs causality the same way the tracer recorded it:
+every traced protocol send carries a message uid in its envelope, the recv
+span that handled it carries the same uid, so each wire edge — through the
+local/grpc/mqtt transports AND the reliable/chaos middleware, retransmits
+collapsed onto their logical message — is one (send span, recv span) pair.
+
+Report sections:
+- round timeline: wall-clock per round with per-rank presence,
+- critical path: per round, the slowest broadcast->train->upload->aggregate
+  chain through the span graph (which worker, and where the time went),
+- straggler ranking: per-rank mean end-to-end contribution,
+- wire anomalies: retransmits / gave_up / dup_dropped / chaos counters,
+- overlap_frac per round (host pipeline stage counters, where present).
+
+Exit codes: 0 clean; 1 structural anomalies — unclosed spans, rounds
+missing on some rank, recv spans with no matching send (span imbalance) —
+or wire gave_up; 2 nothing to analyze. ``--perfetto out.json`` exports the
+merged timeline as Chrome trace_event JSON for Perfetto.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from fedml_tpu.obs.export import read_jsonl, write_chrome_trace  # noqa: E402
+
+
+def load_trace_dir(trace_dir: str) -> list[dict]:
+    """All events from every per-rank file, sorted by timestamp."""
+    events: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "trace-rank*.jsonl"))):
+        events.extend(read_jsonl(path))
+    events.sort(key=lambda e: e.get("ts", 0))
+    return events
+
+
+def _args(ev: dict) -> dict:
+    return ev.get("args") or {}
+
+
+def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
+    """Structure the merged events; returns the full report dict."""
+    rounds: dict[int, dict[int, dict]] = defaultdict(dict)  # round -> rank -> span
+    sends: dict[str, dict] = {}
+    recvs: dict[str, dict] = {}
+    retransmits: list[dict] = []
+    chaos_drops = 0
+    unclosed: list[dict] = []
+    counters: dict[int, dict] = {}
+    stage_rows: dict[int, dict] = {}
+    span_by_sid: dict[tuple, dict] = {}
+    ranks: set[int] = set()
+
+    for ev in events:
+        ph, name = ev.get("ph"), ev.get("name")
+        rank = int(ev.get("rank", 0))
+        if ph != "M":
+            ranks.add(rank)
+        if ph == "O":
+            unclosed.append(ev)
+        elif ph == "X":
+            if ev.get("sid"):
+                span_by_sid[(rank, ev["sid"])] = ev
+            if name == "round" and ev.get("cat") == "round":
+                r = _args(ev).get("round")
+                if r is not None:
+                    prev = rounds[int(r)].get(rank)
+                    # a re-broadcast round keeps its LAST (authoritative) span
+                    if prev is None or ev.get("ts", 0) >= prev.get("ts", 0):
+                        rounds[int(r)][rank] = ev
+            elif name == "send":
+                m = _args(ev).get("mid")
+                if m:
+                    sends[m] = ev
+            elif name == "recv":
+                m = _args(ev).get("mid")
+                if m:
+                    recvs[m] = ev
+        elif ph == "i":
+            if name == "retransmit":
+                retransmits.append(ev)
+            elif name == "chaos_drop":
+                chaos_drops += 1
+        elif ph == "C":
+            if name == "registry":
+                # each flush writes a full CUMULATIVE registry snapshot, so
+                # a file holding several flushes must not be summed — keep
+                # the per-key high-water mark per rank
+                snap = _args(ev).get("values") or {}
+                dst = counters.setdefault(rank, {})
+                for k, v in snap.items():
+                    dst[k] = max(dst.get(k, 0), v)
+            elif name == "host_stages":
+                r = _args(ev).get("round")
+                if r is not None:
+                    stage_rows[int(r)] = _args(ev).get("values") or {}
+
+    # -- structural checks -------------------------------------------------
+    anomalies: list[str] = []
+    if unclosed:
+        for ev in unclosed[:8]:
+            anomalies.append(
+                f"unclosed span {ev.get('name')!r} on rank {ev.get('rank')}"
+                f" (args={_args(ev)})")
+        if len(unclosed) > 8:
+            anomalies.append(f"... and {len(unclosed) - 8} more unclosed spans")
+    round_ranks = {rk for per in rounds.values() for rk in per}
+    for r in sorted(rounds):
+        missing = round_ranks - set(rounds[r])
+        if missing:
+            anomalies.append(
+                f"round {r} missing on rank(s) {sorted(missing)}")
+    orphan_recvs = [m for m in recvs if m not in sends]
+    if orphan_recvs:
+        anomalies.append(
+            f"span imbalance: {len(orphan_recvs)} recv span(s) with no "
+            f"matching send (first mid {orphan_recvs[0]})")
+    if expect_ranks and len(ranks) < expect_ranks:
+        anomalies.append(
+            f"expected {expect_ranks} ranks, found {sorted(ranks)}")
+    wire_total: dict = {}
+    for snap in counters.values():
+        for k, v in snap.items():
+            wire_total[k] = wire_total.get(k, 0) + v
+    if wire_total.get("wire/gave_up", 0):
+        anomalies.append(
+            f"wire gave_up={wire_total['wire/gave_up']}: message(s) "
+            "abandoned after retry exhaustion")
+
+    # -- round timeline + critical path ------------------------------------
+    t0 = min((e.get("ts", 0) for e in events if e.get("ph") != "M"),
+             default=0)
+    # upload lookup for _worker_chain: (worker rank, parent round span) ->
+    # send span, so chain walks don't rescan every send per worker
+    sends_by_parent = {(int(s.get("rank", -1)), s["psid"]): s
+                       for s in sends.values() if s.get("psid")}
+    timeline = []
+    stragglers: dict[int, list[float]] = defaultdict(list)
+    for r in sorted(rounds):
+        per = rounds[r]
+        start = min(e["ts"] for e in per.values())
+        end = max(e["ts"] + e.get("dur", 0) for e in per.values())
+        entry = {
+            "round": r,
+            "start_ms": round((start - t0) / 1e3, 3),
+            "wall_ms": round((end - start) / 1e3, 3),
+            "ranks": sorted(per),
+            "per_rank_ms": {rk: round(per[rk].get("dur", 0) / 1e3, 3)
+                            for rk in sorted(per)},
+        }
+        # critical path: for every WORKER round span, walk its causal chain
+        # (server send -> worker recv -> train -> worker send -> server recv)
+        # via the recorded mids/parent ids; the slowest chain is the path.
+        chains = {}
+        for rk, span in per.items():
+            if _args(span).get("role") != "worker":
+                continue
+            chain = _worker_chain(span, rk, span_by_sid, sends,
+                                  sends_by_parent, recvs)
+            if chain:
+                chains[rk] = chain
+        if chains:
+            best_rk = max(chains, key=lambda rk: chains[rk]["total_ms"])
+            entry["critical_path"] = {"worker_rank": best_rk, **chains[best_rk]}
+            for rk, chain in chains.items():
+                stragglers[rk].append(chain["total_ms"])
+        if r in stage_rows:
+            row = stage_rows[r]
+            host = row.get("materialize_ms", 0) + row.get("h2d_ms", 0)
+            entry["overlap_frac"] = round(
+                max(0.0, 1.0 - row.get("wait_ms", 0) / host), 4) if host > 0 \
+                else 0.0
+            entry["stages_ms"] = {k: round(v, 3) for k, v in row.items()}
+        timeline.append(entry)
+
+    ranking = sorted(
+        ({"rank": rk, "mean_chain_ms": round(sum(v) / len(v), 3),
+          "rounds": len(v)} for rk, v in stragglers.items()),
+        key=lambda x: -x["mean_chain_ms"])
+
+    return {
+        "ranks": sorted(ranks),
+        "rounds": len(rounds),
+        "events": len(events),
+        "timeline": timeline,
+        "straggler_ranking": ranking,
+        "wire": {
+            **{k: v for k, v in sorted(wire_total.items())},
+            "retransmit_instants": len(retransmits),
+            "chaos_drop_instants": chaos_drops,
+        },
+        "anomalies": anomalies,
+    }
+
+
+def _worker_chain(round_span: dict, rank: int, span_by_sid, sends,
+                  sends_by_parent, recvs):
+    """One worker's causal chain for a round, in ms. Returns None when the
+    linkage is incomplete (e.g. an untraced peer)."""
+    # the worker round span nests under the recv span of the sync message
+    parent = span_by_sid.get((rank, round_span.get("psid")))
+    if parent is None or parent.get("name") != "recv":
+        return None
+    mid_down = _args(parent).get("mid")
+    down_send = sends.get(mid_down)
+    # the worker's upload: the send span PARENTED BY this round span
+    up_send = sends_by_parent.get((rank, round_span.get("sid")))
+    up_recv = recvs.get(_args(up_send).get("mid")) if up_send else None
+    if down_send is None or up_recv is None:
+        return None
+    total = (up_recv["ts"] + up_recv.get("dur", 0)) - down_send["ts"]
+    return {
+        "total_ms": round(total / 1e3, 3),
+        "wire_down_ms": round((parent["ts"] - down_send["ts"]) / 1e3, 3),
+        "train_ms": round(round_span.get("dur", 0) / 1e3, 3),
+        "wire_up_ms": round((up_recv["ts"] - up_send["ts"]) / 1e3, 3),
+    }
+
+
+def format_report(rep: dict) -> str:
+    lines = []
+    lines.append(f"fedtrace report: {rep['events']} events, "
+                 f"{len(rep['ranks'])} rank(s) {rep['ranks']}, "
+                 f"{rep['rounds']} round(s)")
+    lines.append("")
+    lines.append("round timeline:")
+    for e in rep["timeline"]:
+        row = (f"  round {e['round']:>3}  start +{e['start_ms']:>9.1f} ms  "
+               f"wall {e['wall_ms']:>9.1f} ms  ranks {e['ranks']}")
+        if "overlap_frac" in e:
+            row += f"  overlap {e['overlap_frac']:.2f}"
+        lines.append(row)
+        cp = e.get("critical_path")
+        if cp:
+            lines.append(
+                f"        critical: worker {cp['worker_rank']} "
+                f"{cp['total_ms']:.1f} ms = down {cp['wire_down_ms']:.1f}"
+                f" + train {cp['train_ms']:.1f}"
+                f" + up {cp['wire_up_ms']:.1f}")
+    if rep["straggler_ranking"]:
+        lines.append("")
+        lines.append("straggler ranking (mean causal-chain ms, worst first):")
+        for s in rep["straggler_ranking"]:
+            lines.append(f"  rank {s['rank']:>3}  {s['mean_chain_ms']:>9.1f} ms"
+                         f"  over {s['rounds']} round(s)")
+    wire = {k: v for k, v in rep["wire"].items() if v}
+    if wire:
+        lines.append("")
+        lines.append("wire summary: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(wire.items())))
+    lines.append("")
+    if rep["anomalies"]:
+        lines.append(f"ANOMALIES ({len(rep['anomalies'])}):")
+        lines.extend(f"  - {a}" for a in rep["anomalies"])
+    else:
+        lines.append("no structural anomalies")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace_dir", help="directory of trace-rank*.jsonl files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="also write the merged Chrome trace_event JSON here")
+    ap.add_argument("--expect-ranks", type=int, default=0,
+                    help="fail unless at least this many ranks are present")
+    args = ap.parse_args(argv)
+
+    events = load_trace_dir(args.trace_dir)
+    if not events:
+        print(f"no trace-rank*.jsonl events under {args.trace_dir}",
+              file=sys.stderr)
+        return 2
+    rep = analyze(events, expect_ranks=args.expect_ranks)
+    if args.perfetto:
+        write_chrome_trace(args.perfetto, events)
+        rep["perfetto"] = args.perfetto
+    print(json.dumps(rep, indent=2) if args.json else format_report(rep))
+    return 1 if rep["anomalies"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
