@@ -435,6 +435,21 @@ def main():
                   f"15% — T(c) is no longer linear in silos; investigate",
                   file=sys.stderr)
 
+    # End-of-run registry snapshot (fedml_tpu/obs): the time/wire/compile
+    # counter groups land in the BENCH JSON tail, so the TPU-host trajectory
+    # tracks compile amortization (program builds, LRU hits, first-call
+    # trace+XLA ms) across PRs — not just wall-clock throughput.
+    from fedml_tpu.obs import default_registry
+
+    reg = default_registry()
+    registry_snapshot = {}
+    for ns in ("time", "wire", "compile"):
+        snap = reg.snapshot(ns)
+        if snap:
+            registry_snapshot[ns] = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in snap.items()}
+
     result = {
         "metric": f"fedavg_local_sgd_images_per_sec ({model}, CIFAR-10 shapes, 32 non-IID clients, 8/round, bf16)",
         "value": round(img_per_sec, 1),
@@ -455,6 +470,7 @@ def main():
                       "fwd_bwd_multiplier": 3.0,
                       "peak_table_entry": peak_entry,
                       "peak_bf16_flops": peak},
+        "registry": registry_snapshot,
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(result))

@@ -95,7 +95,9 @@ class DecentralizedFedAPI(FedAvgAPI):
 
         return round_step
 
-    def run_round(self, round_idx: int) -> float:
+    def _run_round_inner(self, round_idx: int) -> float:
+        # the traced-span wrapper is the inherited run_round (fedavg.py);
+        # overriding the INNER hook keeps gossip rounds on the one timeline
         from fedml_tpu.core.rng import round_key
 
         cx, cy, cm, counts = self.dataset.client_slice(np.arange(self.dataset.num_clients))
@@ -176,7 +178,7 @@ class MeshDecentralizedFedAPI(DecentralizedFedAPI):
         return make_gossip_round(self._local_train, self.mesh,
                                  pushsum=self.mode == "pushsum")
 
-    def run_round(self, round_idx: int) -> float:
+    def _run_round_inner(self, round_idx: int) -> float:
         from fedml_tpu.core.rng import round_key
         from fedml_tpu.parallel.gossip import place_gossip_inputs
 
@@ -193,7 +195,8 @@ class MeshDecentralizedFedAPI(DecentralizedFedAPI):
             jax.random.split(rk, self.dataset.num_clients),
             jax.sharding.NamedSharding(self.mesh,
                                        jax.sharding.PartitionSpec("nodes")))
-        self.node_vars, self.ps_weights, loss = self._round_step(
+        self.node_vars, self.ps_weights, loss = self._traced_device_step(
+            "gossip", round_idx, self._round_step,
             self.node_vars, self.ps_weights, W, cx, cy, cm, counts, keys)
         self._update_consensus()
         return float(loss)

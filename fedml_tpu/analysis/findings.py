@@ -37,6 +37,11 @@ RULES: Dict[str, str] = {
         "every argparse --flag must be read somewhere in the package, and "
         "every config/args attribute read must name a defined flag or field"
     ),
+    "trace-coverage": (
+        "run_round/run_superstep overrides must route through the fedtrace "
+        "span wrapper (override _run_round_inner, delegate to super(), or "
+        "open the span) so no paradigm drops out of the round timeline"
+    ),
     "bad-suppression": (
         "a fedlint suppression comment names a rule that does not exist"
     ),

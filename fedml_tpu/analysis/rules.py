@@ -367,6 +367,70 @@ def check_config_flag_drift(
     return out
 
 
+# -------------------------------------------------------- trace-coverage
+
+#: the round entry points the fedtrace wrapper owns (fedavg.py run_round
+#: wraps _run_round_inner; run_superstep is the reserved name for a future
+#: block-granular public entry)
+_TRACED_ENTRY_POINTS = {"run_round", "run_superstep"}
+#: calls that prove a method opens the trace gate itself
+_TRACE_GATES = {"tracer_if_enabled", "get_tracer"}
+#: span-opening attribute calls on a tracer
+_SPAN_OPENERS = {"span", "begin_span", "emit_complete"}
+
+
+def _is_super_delegation(node: ast.Call) -> bool:
+    """``super().run_round(...)`` / ``super().run_superstep(...)`` — the
+    override funnels back into the traced base wrapper."""
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr in _TRACED_ENTRY_POINTS
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Name)
+            and f.value.func.id == "super")
+
+
+def check_trace_coverage(pkg: PackageIndex, graph: TracedGraph) -> List[Finding]:
+    """Every ``run_round`` / ``run_superstep`` method must route through the
+    traced span wrapper (fedml_tpu/obs): fedtrace's one-timeline guarantee
+    holds only because the base ``run_round`` is THE wrapper and paradigm
+    logic lives in ``_run_round_inner``. An override of the entry point that
+    neither opens a span itself nor delegates to ``super()`` silently drops
+    its paradigm's rounds from the trace — exactly the mesh gap this rule
+    was added to close (ISSUE 5)."""
+    out: List[Finding] = []
+    for mod in pkg.modules:
+        for fn in mod.functions:
+            if fn.name not in _TRACED_ENTRY_POINTS or fn.cls is None:
+                continue
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            opens_gate = opens_span = delegates = False
+            for node in walk_excluding_nested(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_super_delegation(node):
+                    delegates = True
+                    break
+                d = dotted_name(node.func)
+                if d is None:
+                    continue
+                tail = d.split(".")[-1]
+                if tail in _TRACE_GATES:
+                    opens_gate = True
+                elif tail in _SPAN_OPENERS:
+                    opens_span = True
+            if delegates or (opens_gate and opens_span):
+                continue
+            out.append(Finding(
+                "trace-coverage", mod.relpath, fn.node.lineno,
+                f"'{fn.qualname}' overrides traced entry point '{fn.name}' "
+                "without routing through the span wrapper — rename it to "
+                "'_run_round_inner' (the base run_round wraps that), "
+                "delegate via super(), or open the round span itself",
+            ))
+    return out
+
+
 #: checkable rule-id -> implementation (bad-suppression is emitted by the
 #: suppression parser, not a checker)
 CHECKS = {
@@ -375,4 +439,5 @@ CHECKS = {
     "seeded-rng": check_seeded_rng,
     "protocol-exhaustiveness": check_protocol_exhaustiveness,
     "config-flag-drift": check_config_flag_drift,
+    "trace-coverage": check_trace_coverage,
 }

@@ -217,6 +217,12 @@ class FedConfig:
     # ring-buffer bound per rank: oldest events fall off instead of
     # growing the heap on a weeks-long federation
     trace_buffer_events: int = 65536
+    # fedscope device-memory sampler: when tracing is on, snapshot
+    # jax.local_devices() memory_stats (bytes_in_use + peak watermark) at
+    # every round boundary into a "device" counter lane (one allocator read
+    # per device per round, host-side, never syncs the device stream; CPU
+    # backends fall back to one process-RSS read). Off = spans only.
+    trace_device_sampler: bool = True
 
     # checkpoint/resume (absent in the reference, SURVEY.md §5.4)
     checkpoint_dir: Optional[str] = None
@@ -447,6 +453,10 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--trace_buffer_events", type=int,
                    default=defaults.trace_buffer_events,
                    help="per-rank trace ring-buffer bound (events)")
+    p.add_argument("--trace_device_sampler", type=lambda s: bool(int(s)),
+                   default=defaults.trace_device_sampler,
+                   help="sample per-device memory at round boundaries into "
+                        "the trace's device lane (0|1; traced runs only)")
     p.add_argument("--run_name", type=str, default=defaults.run_name)
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)

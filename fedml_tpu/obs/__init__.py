@@ -17,12 +17,20 @@ assumes (arXiv:2303.01778):
   to recv spans across ranks and transports by message id.
 - :mod:`fedml_tpu.obs.export` — Perfetto/Chrome ``trace_event`` JSON and
   JSONL exporters; ``tools/trace_report.py`` is the analyzer.
+- :mod:`fedml_tpu.obs.compile` (fedscope) — per-program compile telemetry:
+  LRU hit/miss counters plus build / first-call spans, so compile-vs-execute
+  time is a first-class, regression-testable metric.
+- :mod:`fedml_tpu.obs.device` (fedscope) — device-memory sampler at round
+  boundaries; a "devices" counter lane in the Perfetto export without a
+  separate ``--profile_dir`` profiler run.
 
 Tracing is OFF by default and enabled per run via ``--trace_dir``
 (core/config.py). The contract: a traced run is bit-identical to an
 untraced run — the tracer only ever reads clocks.
 """
 
+from fedml_tpu.obs.compile import compile_counters, record_cache_hit, timed_build
+from fedml_tpu.obs.device import sample_device_memory
 from fedml_tpu.obs.registry import (
     CounterGroup,
     MetricsRegistry,
@@ -35,6 +43,8 @@ from fedml_tpu.obs.tracer import (
     flush_all,
     get_tracer,
     reset,
+    set_process_index,
+    trace_filename,
     tracer_if_enabled,
     tracing_enabled,
 )
@@ -43,12 +53,18 @@ __all__ = [
     "CounterGroup",
     "MetricsRegistry",
     "Tracer",
+    "compile_counters",
     "configure",
     "configure_from",
     "default_registry",
     "flush_all",
     "get_tracer",
+    "record_cache_hit",
     "reset",
+    "sample_device_memory",
+    "set_process_index",
+    "timed_build",
+    "trace_filename",
     "tracer_if_enabled",
     "tracing_enabled",
 ]

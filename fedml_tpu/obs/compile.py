@@ -1,0 +1,101 @@
+"""Compile telemetry: attribute program-build time per round-program shape.
+
+Every distinct round plan (cohort bucket tuple, packed shape key, super-step
+block length) compiles its own XLA program, and on the TPU bench host a
+fresh compile goes through the remote-compile tunnel — minutes, not
+milliseconds. Before this module that cost was invisible: it landed inside
+whichever round happened to trigger the build. :func:`timed_build` makes it
+first-class:
+
+- a ``compile`` :class:`CounterGroup` on the default registry accumulates
+  ``hits`` / ``misses`` / ``build_ms`` / ``first_call_ms`` — cheap enough to
+  run unconditionally (each event is one dict store), so the numbers exist
+  even in untraced runs (bench.py embeds them in its JSON tail);
+- when tracing is on, each build also emits two ``compile``-category spans:
+  ``<name>:build`` around the program CONSTRUCTION (builder() returns the
+  jitted callable without compiling — usually sub-ms) and
+  ``<name>:first_call`` around the first invocation, which is where jax
+  traces and XLA compiles before dispatch. With ``async_rounds`` the first
+  call still blocks until the executable exists (dispatch needs it), so
+  first_call_ms ≈ trace + compile time — the number the tunnel makes
+  expensive — without the tracer ever forcing a device sync.
+
+The wrapper returned by :func:`timed_build` is numerically transparent: it
+forwards ``*args`` untouched and only reads clocks, preserving the
+traced == untraced bit-identity contract.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from fedml_tpu.obs.registry import CounterGroup, default_registry
+from fedml_tpu.obs.tracer import tracer_if_enabled
+
+_KEYS = ("hits", "misses", "build_ms", "first_call_ms")
+#: module-global strong ref: the registry only holds weakrefs, and compile
+#: accounting is process-lifetime (rank 0 owns it so per-rank registry
+#: snapshots don't multiply-count one process-wide group)
+_GROUP: Optional[CounterGroup] = None
+
+
+def compile_counters() -> CounterGroup:
+    """The process-wide ``compile`` counter group (created on first use)."""
+    global _GROUP
+    if _GROUP is None:
+        _GROUP = default_registry().group("compile", rank=0, keys=_KEYS)
+    return _GROUP
+
+
+def record_cache_hit(name: str) -> None:
+    """One LRU hit: the compiled program was reused, no build happened.
+    Attributed both in aggregate and per program name, so a report can say
+    which cache is hot vs thrashing."""
+    g = compile_counters()
+    g["hits"] = g.get("hits", 0) + 1
+    g[f"hits.{name}"] = g.get(f"hits.{name}", 0) + 1
+
+
+def timed_build(name: str, shape_key, builder: Callable) -> Callable:
+    """Run ``builder()`` under compile telemetry; return the built step
+    wrapped so its FIRST invocation (where trace + XLA compile happen) is
+    timed and attributed too. ``shape_key`` is recorded (repr'd) on the
+    spans so a report can say WHICH program shape cost the time."""
+    g = compile_counters()
+    g["misses"] = g.get("misses", 0) + 1
+    g[f"misses.{name}"] = g.get(f"misses.{name}", 0) + 1
+    tr = tracer_if_enabled(0)
+    t0 = time.perf_counter()
+    if tr is None:
+        fn = builder()
+    else:
+        with tr.span(f"{name}:build", cat="compile",
+                     args={"shape_key": repr(shape_key)}):
+            fn = builder()
+    g["build_ms"] = g.get("build_ms", 0.0) + (time.perf_counter() - t0) * 1e3
+
+    first = [True]
+
+    def step(*args):
+        if not first[0]:
+            return fn(*args)
+        first[0] = False
+        tr = tracer_if_enabled(0)
+        t0 = time.perf_counter()
+        if tr is None:
+            out = fn(*args)
+        else:
+            with tr.span(f"{name}:first_call", cat="compile",
+                         args={"shape_key": repr(shape_key)}):
+                out = fn(*args)
+        g["first_call_ms"] = g.get("first_call_ms", 0.0) + (
+            time.perf_counter() - t0) * 1e3
+        return out
+
+    # the packed mesh round carries its un-jitted body as `.raw` (the
+    # super-step scans it); keep such sidecar attributes reachable
+    raw = getattr(fn, "raw", None)
+    if raw is not None:
+        step.raw = raw
+    return step

@@ -5,15 +5,28 @@ The native on-disk format is one JSON object per line (what
 ``i`` instant, ``C`` counter, ``O`` unclosed-at-flush span, ``M`` file
 metadata. :func:`to_chrome_trace` converts a merged multi-rank event list
 into the ``trace_event`` JSON that Perfetto / ``chrome://tracing`` loads
-directly: rank -> ``pid`` (one process track per rank), thread -> ``tid``,
-and every send/recv span pair linked by message uid becomes a flow arrow
-(``ph: s``/``f``) so the cross-rank causal chain is drawn, not inferred.
+directly: (process, rank) -> ``pid`` (one process track per rank; per-host
+files from a jax.distributed run carry a ``proc`` tag and get their own
+track block), thread -> ``tid``, and every send/recv span pair linked by
+message uid becomes a flow arrow (``ph: s``/``f``) so the cross-rank
+causal chain is drawn, not inferred. The fedscope device-memory sampler's
+``device``-category counters are routed to a dedicated "devices" track so
+the HBM lane sits apart from the span timeline.
 """
 
 from __future__ import annotations
 
 import json
 from typing import Iterable, Optional
+
+#: base pid of the dedicated counter tracks for device-category samples
+#: (one per host: pid = DEVICE_LANE_PID - proc). Negative, so the lanes
+#: can never collide with the non-negative (proc, rank) span pids no
+#: matter how many hosts/ranks a run has; Perfetto treats pid as an
+#: opaque int64, so negative track ids render fine.
+DEVICE_LANE_PID = -1
+#: per-host pid stride: pid = proc * stride + rank (ranks stay < stride)
+_PROC_PID_STRIDE = 100_000
 
 
 def read_jsonl(path: str) -> list[dict]:
@@ -41,18 +54,22 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
     (``{"traceEvents": [...]}``). Metadata lines become process_name
     entries; send->recv message uids become flow events."""
     out = []
-    seen_ranks = set()
+    seen_pids = set()
+    device_lanes_named = set()
     sends: dict[str, dict] = {}
     recvs: dict[str, dict] = {}
     for ev in events:
         ph = ev.get("ph")
         rank = int(ev.get("rank", 0))
-        if rank not in seen_ranks:
-            seen_ranks.add(rank)
-            out.append({"ph": "M", "name": "process_name", "pid": rank,
-                        "args": {"name": f"rank {rank}"}})
+        proc = int(ev.get("proc", 0))
+        pid = proc * _PROC_PID_STRIDE + rank
+        if pid not in seen_pids:
+            seen_pids.add(pid)
+            label = f"p{proc} rank {rank}" if proc else f"rank {rank}"
+            out.append({"ph": "M", "name": "process_name", "pid": pid,
+                        "args": {"name": label}})
         base = {"name": ev.get("name"), "cat": ev.get("cat", "app"),
-                "ts": ev.get("ts", 0), "pid": rank,
+                "ts": ev.get("ts", 0), "pid": pid,
                 "tid": ev.get("tid", 0)}
         ev_args = dict(ev.get("args") or {})
         if ph == "X":
@@ -65,6 +82,18 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
             out.append({**base, "ph": "i", "s": "t", "args": ev_args})
         elif ph == "C":
             vals = ev_args.get("values") or {}
+            if ev.get("cat") == "device":
+                # the device-memory sampler gets its own counter lane —
+                # one PER HOST: merged multi-host traces repeat the same
+                # series keys (d0/..., host/rss_bytes), and a shared track
+                # would interleave unrelated hosts into one sawtooth
+                lane_pid = DEVICE_LANE_PID - proc
+                if lane_pid not in device_lanes_named:
+                    device_lanes_named.add(lane_pid)
+                    label = f"devices p{proc}" if proc else "devices"
+                    out.append({"ph": "M", "name": "process_name",
+                                "pid": lane_pid, "args": {"name": label}})
+                base = {**base, "pid": lane_pid}
             # Chrome counter events take flat numeric args
             out.append({**base, "ph": "C",
                         "args": {k: v for k, v in vals.items()
@@ -74,16 +103,19 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
             out.append({**base, "ph": "i", "s": "p",
                         "args": {**ev_args, "unclosed": True}})
     # flow arrows: one per (send, recv) pair sharing a message uid
+    def _pid(ev):
+        return int(ev.get("proc", 0)) * _PROC_PID_STRIDE + int(ev.get("rank", 0))
+
     for m, s in sends.items():
         r = recvs.get(m)
         if r is None:
             continue
         flow = {"name": "msg", "cat": "comm", "id": _flow_id(m)}
         out.append({**flow, "ph": "s", "ts": s.get("ts", 0),
-                    "pid": int(s.get("rank", 0)), "tid": s.get("tid", 0)})
+                    "pid": _pid(s), "tid": s.get("tid", 0)})
         out.append({**flow, "ph": "f", "bp": "e",
                     "ts": r.get("ts", 0) + int(r.get("dur", 0) or 0),
-                    "pid": int(r.get("rank", 0)), "tid": r.get("tid", 0)})
+                    "pid": _pid(r), "tid": r.get("tid", 0)})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 

@@ -1,7 +1,13 @@
 """Per-rank span tracer with cross-rank causality.
 
 One :class:`Tracer` per rank (in-process federations run many ranks in one
-process; the per-rank deployment runs one per OS process). Each traces
+process; the per-rank deployment runs one per OS process). Tracer identity
+is ``(process_index, rank)``: under ``jax.distributed`` every HOST process
+runs the same mesh loop, so each host tags its events with its process
+index and flushes to its own file (``trace-p<p>-rank<r>.jsonl``; process 0
+keeps the legacy ``trace-rank<r>.jsonl`` name so single-host traces are
+unchanged). ``tools/trace_report.py`` merges the per-host files on the
+shared wall-µs timebase. Each tracer records
 spans (duration events), instants, and counters into a bounded ring buffer
 — monotonic-clock durations, wall-clock timestamps for cross-process
 alignment — and flushes to ``<trace_dir>/trace-rank<r>.jsonl``.
@@ -113,8 +119,9 @@ class Tracer:
     """Thread-safe per-rank event buffer; see module docstring."""
 
     def __init__(self, rank: int = 0, buffer_events: int = 65536,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None, process: int = 0):
         self.rank = int(rank)
+        self.process = int(process)
         self.enabled = True
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
         # deque.append is atomic under the GIL; the ring bound makes an
@@ -145,6 +152,10 @@ class Tracer:
               span_id, parent_id, args) -> None:
         ev = {"ph": ph, "name": name, "cat": cat, "ts": ts_us,
               "rank": self.rank, "tid": threading.get_ident() & 0xFFFF}
+        if self.process:
+            # only multi-host events carry the field: single-process traces
+            # (and their golden fixtures) keep the exact legacy shape
+            ev["proc"] = self.process
         if dur_us is not None:
             ev["dur"] = dur_us
         if span_id:
@@ -211,6 +222,22 @@ class Tracer:
         a["values"] = v
         self._emit("C", name, cat, _now_us(), None, 0, None, a)
 
+    def emit_complete(self, name: str, cat: str, ts_us: int, dur_us: int,
+                      parent_id: Optional[int] = None,
+                      args: Optional[dict] = None) -> int:
+        """Emit a complete span with an EXPLICIT placement on the timeline.
+
+        For synthetic attribution spans whose extent was computed, not
+        measured around a ``with`` block — e.g. the super-step path amortizes
+        one measured device span over its covered rounds by emitting one
+        child span per round at ``blk_dur / h`` each. Returns the span id."""
+        if not self.enabled:
+            return 0
+        sid = self._next_id()
+        self._emit("X", name, cat, int(ts_us), max(int(dur_us), 0), sid,
+                   parent_id, args)
+        return sid
+
     def make_ctx(self, span_id: int) -> list:
         """Wire context for one message: (trace id, parent span id, uid)."""
         return [self.trace_id, int(span_id), uuid.uuid4().hex[:16]]
@@ -232,6 +259,7 @@ class Tracer:
             items = list(self._open.items())
         return [{"ph": "O", "name": name, "cat": cat, "ts": ts_us,
                  "rank": self.rank, "sid": sid,
+                 **({"proc": self.process} if self.process else {}),
                  **({"psid": parent} if parent else {}),
                  **({"args": a} if a else {})}
                 for _k, (sid, parent, name, cat, ts_us, _t0, a) in items]
@@ -251,6 +279,7 @@ class Tracer:
         if not events and not extra:
             return 0
         header = {"ph": "M", "name": "trace_meta", "rank": self.rank,
+                  **({"proc": self.process} if self.process else {}),
                   "ts": _now_us(), "args": {"trace_id": self.trace_id}}
         with open(path, "a") as f:
             for ev in [header, *events, *extra]:
@@ -278,6 +307,36 @@ _BUFFER = 65536
 _TRACERS: dict[int, Tracer] = {}
 _TRACE_ID: Optional[str] = None
 _JAX_BRIDGE = False
+#: this host's process index under jax.distributed; None = resolve lazily
+#: from jax.process_index() at first tracer creation
+_PROCESS: Optional[int] = None
+
+
+def set_process_index(process_index: Optional[int]) -> None:
+    """Pin this process's tracer identity (the ``p`` of (process, rank)).
+
+    ``parallel/mesh.init_multihost`` calls this with ``jax.process_index()``
+    after joining the cluster; ``None`` restores lazy resolution. Existing
+    tracers are NOT retagged — set it before the run starts tracing."""
+    global _PROCESS
+    with _lock:
+        _PROCESS = None if process_index is None else int(process_index)
+
+
+def _process_index() -> int:
+    """Resolved process index (0 outside multi-process runs). Never forces
+    backend init: an unpinned index only asks jax when a distributed client
+    is already up, so single-process tracing stays jax-init-free."""
+    if _PROCESS is not None:
+        return _PROCESS
+    try:
+        import jax
+
+        if jax.distributed.is_initialized():
+            return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable here
+        pass
+    return 0
 
 
 def configure(trace_dir: Optional[str], buffer_events: int = 65536,
@@ -332,7 +391,8 @@ def get_tracer(rank: int = 0) -> Tracer:
         tr = _TRACERS.get(rank)
         if tr is None:
             tr = _TRACERS[rank] = Tracer(rank, buffer_events=_BUFFER,
-                                         trace_id=_TRACE_ID)
+                                         trace_id=_TRACE_ID,
+                                         process=_process_index())
             if _JAX_BRIDGE:
                 try:
                     import jax
@@ -351,8 +411,18 @@ def tracer_if_enabled(rank: int = 0) -> Optional[Tracer]:
     return get_tracer(rank)
 
 
+def trace_filename(rank: int, process: int = 0) -> str:
+    """Per-(process, rank) trace file name. Process 0 keeps the legacy
+    single-host name so existing traces and tooling are unchanged; other
+    hosts get a distinct file they can write into a SHARED directory
+    without clobbering each other."""
+    if process:
+        return f"trace-p{process}-rank{rank}.jsonl"
+    return f"trace-rank{rank}.jsonl"
+
+
 def flush_all(trace_dir: Optional[str] = None) -> list[str]:
-    """Flush every live tracer to ``<dir>/trace-rank<r>.jsonl`` (append),
+    """Flush every live tracer to its per-(process, rank) file (append),
     including a per-rank counter snapshot from the default registry.
     Returns the paths written."""
     from fedml_tpu.obs.registry import default_registry
@@ -365,7 +435,7 @@ def flush_all(trace_dir: Optional[str] = None) -> list[str]:
         tracers = list(_TRACERS.values())
     paths = []
     for tr in tracers:
-        p = os.path.join(d, f"trace-rank{tr.rank}.jsonl")
+        p = os.path.join(d, trace_filename(tr.rank, tr.process))
         if tr.flush(p, registry=default_registry()):
             paths.append(p)
     return paths
@@ -373,9 +443,10 @@ def flush_all(trace_dir: Optional[str] = None) -> list[str]:
 
 def reset() -> None:
     """Drop all tracers and disable tracing (tests; never mid-run)."""
-    global _ENABLED, _TRACE_DIR, _TRACE_ID
+    global _ENABLED, _TRACE_DIR, _TRACE_ID, _PROCESS
     with _lock:
         _ENABLED = False
         _TRACE_DIR = None
         _TRACE_ID = None
+        _PROCESS = None
         _TRACERS.clear()

@@ -34,7 +34,8 @@ def hierarchical_mesh(num_groups: int, clients_per_group: int) -> Mesh:
 
 def init_multihost(coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
-                   process_id: Optional[int] = None) -> int:
+                   process_id: Optional[int] = None,
+                   config=None) -> int:
     """Join a multi-host TPU pod (or GPU/CPU cluster) run.
 
     Counterpart of the reference's mpirun + hostfile + rank→IP csv bootstrap
@@ -42,9 +43,18 @@ def init_multihost(coordinator_address: Optional[str] = None,
     ``jax.distributed.initialize`` (env-driven on TPU pods — all args
     optional there) after which ``jax.devices()`` spans every host and the
     same Mesh/psum code runs unchanged with DCN collectives between hosts.
-    Returns this process's index. Idempotent: repeated calls are no-ops.
+    Returns this process's index. Idempotent: repeated calls are no-ops
+    (tracing setup included — ``config`` is honored on every call).
+
+    ``config`` (a FedConfig) additionally wires fedscope per-host tracing:
+    tracer identity becomes (process_index, rank), so every host writes its
+    own ``trace-p<p>-rank<r>.jsonl`` into the shared ``--trace_dir`` and
+    ``tools/trace_report.py`` merges them on the wall-µs timebase. A flush
+    hook is registered so a host that exits without reaching ``train()``'s
+    finally still writes what it buffered.
     """
     if getattr(init_multihost, "_done", False) or jax.distributed.is_initialized():
+        _configure_host_tracing(config)
         return jax.process_index()
     kw = {}
     if coordinator_address is not None:
@@ -55,7 +65,28 @@ def init_multihost(coordinator_address: Optional[str] = None,
         kw["process_id"] = process_id
     jax.distributed.initialize(**kw)
     init_multihost._done = True
+    _configure_host_tracing(config)
     return jax.process_index()
+
+
+def _configure_host_tracing(config) -> bool:
+    """Per-host fedscope tracer setup (see :func:`init_multihost`). Returns
+    whether tracing ended up enabled. Safe to call repeatedly."""
+    if config is None:
+        return False
+    from fedml_tpu.obs import configure_from, set_process_index
+
+    set_process_index(jax.process_index())
+    if not configure_from(config):
+        return False
+    if not getattr(_configure_host_tracing, "_atexit", False):
+        import atexit
+
+        from fedml_tpu.obs import flush_all
+
+        atexit.register(flush_all)
+        _configure_host_tracing._atexit = True
+    return True
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

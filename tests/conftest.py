@@ -28,6 +28,7 @@ jax.config.update("jax_threefry_partitionable", True)
 # jit cache never shares), and on the 2-vCPU CI box compilation dominates the
 # tier-1 wall clock. Keyed by HLO hash, so a hit returns the same executable —
 # numerics are unaffected. Set FEDML_TPU_NO_COMPILE_CACHE=1 to disable.
+_cache_dir = None
 if not os.environ.get("FEDML_TPU_NO_COMPILE_CACHE"):
     _cache_dir = os.environ.get(
         "FEDML_TPU_COMPILE_CACHE_DIR",
@@ -36,6 +37,28 @@ if not os.environ.get("FEDML_TPU_NO_COMPILE_CACHE"):
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+# Compile-cache observability (fedscope): count the XLA persistent-cache
+# hit/miss events jax publishes through jax.monitoring, so the session can
+# end with a one-line summary — a cold cache (or a config change that
+# silently re-keys every program) shows up as a miss storm in the tier-1
+# log instead of as an unexplained budget blowout. tools/t1_report.py
+# parses these lines back out of the tee'd log.
+_CACHE_EVENTS = {"hits": 0, "misses": 0}
+
+
+def _cache_event_listener(event: str, **kw):
+    if event == "/jax/compilation_cache/cache_hits":
+        _CACHE_EVENTS["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        _CACHE_EVENTS["misses"] += 1
+
+
+jax.monitoring.register_event_listener(_cache_event_listener)
+
+#: wall seconds per test FILE (setup+call+teardown summed over its tests);
+#: printed as one machine-parseable line for tools/t1_report.py
+_FILE_SECONDS: dict = {}
 
 
 def pytest_configure(config):
@@ -46,3 +69,35 @@ def pytest_configure(config):
         "chaos: seeded wire-fault injection (comm/chaos.py); small enough "
         "to stay inside the tier-1 time budget — tools/chaos_sweep.py runs "
         "the wide multi-seed version")
+
+
+def pytest_runtest_logreport(report):
+    path = report.nodeid.split("::", 1)[0]
+    _FILE_SECONDS[path] = _FILE_SECONDS.get(path, 0.0) + (
+        getattr(report, "duration", 0.0) or 0.0)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json
+
+    entries = -1
+    if _cache_dir and os.path.isdir(_cache_dir):
+        try:
+            entries = len(os.listdir(_cache_dir))
+        except OSError:
+            pass
+    tw = getattr(session.config, "get_terminal_writer", lambda: None)()
+    emit = tw.line if tw is not None else print
+    # the writer sits mid-line after the last progress dot; break first so
+    # the [t1] text can never glue onto a dots line (the tier-1 gate counts
+    # dots with a ^...$ regex — a suffixed line would drop out of the count)
+    emit("")
+    entries_txt = "n/a" if entries < 0 else str(entries)
+    emit(
+        f"[t1] compile-cache: {_CACHE_EVENTS['hits']} hit(s) / "
+        f"{_CACHE_EVENTS['misses']} miss(es) this session, "
+        f"{entries_txt} persistent entries"
+        + (f" in {os.path.basename(_cache_dir)}" if _cache_dir else " (cache disabled)"))
+    slowest = sorted(_FILE_SECONDS.items(), key=lambda kv: -kv[1])[:10]
+    emit("[t1] file-seconds: " + json.dumps(
+        [[p, round(s, 1)] for p, s in slowest]))

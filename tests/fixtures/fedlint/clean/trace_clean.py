@@ -1,0 +1,26 @@
+"""Clean twin: wrapper opens the span; overrides use the inner hook or
+delegate back into the traced base."""
+
+from fedml_tpu.obs import tracer_if_enabled
+
+
+class BaseAPI:
+    def run_round(self, round_idx):
+        tr = tracer_if_enabled(0)
+        if tr is None:
+            return self._run_round_inner(round_idx)
+        with tr.span("round", cat="round", args={"round": round_idx}):
+            return self._run_round_inner(round_idx)
+
+    def _run_round_inner(self, round_idx):
+        return round_idx
+
+
+class MeshAPI(BaseAPI):
+    def _run_round_inner(self, round_idx):
+        return round_idx * 2
+
+
+class LoggingAPI(BaseAPI):
+    def run_round(self, round_idx):
+        return super().run_round(round_idx)

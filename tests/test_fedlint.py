@@ -31,10 +31,11 @@ def _by_file(findings):
 
 
 def test_rule_catalog_complete():
-    # the five shipped rules + the suppression-integrity meta rule
+    # the six shipped rules + the suppression-integrity meta rule
     assert set(RULES) == {
         "traced-purity", "retrace-hazard", "seeded-rng",
-        "protocol-exhaustiveness", "config-flag-drift", "bad-suppression",
+        "protocol-exhaustiveness", "config-flag-drift", "trace-coverage",
+        "bad-suppression",
     }
 
 
@@ -64,6 +65,9 @@ def test_bad_corpus_exact_rule_ids_and_lines():
             # the unknown rule is an error AND does not suppress anything
             ("bad-suppression", 4),
             ("seeded-rng", 4),
+        ],
+        "trace_bad.py": [
+            ("trace-coverage", 5),   # run_round override bypasses the wrapper
         ],
     }
 
@@ -125,7 +129,8 @@ def test_cli_json_exit_codes_and_payload():
     assert payload["ok"] is False
     assert {f["rule"] for f in payload["findings"]} == {
         "traced-purity", "retrace-hazard", "seeded-rng",
-        "protocol-exhaustiveness", "config-flag-drift", "bad-suppression",
+        "protocol-exhaustiveness", "config-flag-drift", "trace-coverage",
+        "bad-suppression",
     }
     clean = _run_cli(CLEAN, "--format", "json")
     assert clean.returncode == 0, clean.stderr

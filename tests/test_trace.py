@@ -350,6 +350,18 @@ def test_wire_counters_visible_through_registry():
     assert rel.stats["sent"] == 1                      # legacy view
     assert default_registry().snapshot("wire")["sent"] >= before + 1
     rel.stop_receive_message()
+    # rank 1 has no manager, so the send above retries until it gives up on
+    # a background thread; wait that storm out HERE — otherwise the live
+    # manager's gave_up counter leaks into later tests' registry snapshots
+    import time
+
+    deadline = time.monotonic() + 30
+    while getattr(rel, "_outstanding", {}) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not rel._outstanding, "wire drain did not finish in 30 s"
+    rel._retx.join(timeout=10)   # the loop thread holds the manager alive
+    del rel
+    gc.collect()
 
 
 def test_round_timer_feeds_registry_and_monotonic_wall():
@@ -420,3 +432,210 @@ def test_trace_flags_validated():
         FedConfig(trace_buffer_events=0)
     c = FedConfig(trace_dir="/tmp/x", trace_buffer_events=128)
     assert c.trace_dir == "/tmp/x"
+    assert c.trace_device_sampler is True
+    assert FedConfig(trace_device_sampler=False).trace_device_sampler is False
+
+
+# -- fedscope: mesh-paradigm spans, compile + device telemetry --------------
+
+def _mesh_cfg(trace_dir=None, **kw):
+    base = dict(
+        model="lr", client_num_in_total=4, client_num_per_round=4,
+        comm_round=4, batch_size=4, lr=0.1, frequency_of_the_test=2,
+        seed=0, device_data="on", pack_lanes=2, rounds_per_step=2,
+        trace_dir=trace_dir,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _mesh_run(trace_dir):
+    from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    obs.reset()
+    gc.collect()   # drop dead counter groups other tests' managers left
+    ds = make_synthetic_classification(
+        "mesh-tr", (6,), 3, 4, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=0)
+    api = CrossSiloFedAvgAPI(
+        ds, _mesh_cfg(trace_dir),
+        create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:]),
+        mesh=client_mesh(2))
+    hist = api.train()
+    assert api._packed_mesh is not None   # the run exercised the packed path
+    return hist, api
+
+
+def test_traced_mesh_superstep_run_bit_identical(tmp_path):
+    """The mesh mirror of the sim/edge bit-identity pins: a traced packed
+    super-step cross-silo run computes exactly the untraced weights."""
+    traced_hist, traced_api = _mesh_run(str(tmp_path / "traces"))
+    plain_hist, plain_api = _mesh_run(None)
+    assert traced_hist["Test/Acc"] == plain_hist["Test/Acc"]
+    assert traced_hist["Test/Loss"] == plain_hist["Test/Loss"]
+    for a, b in zip(jax.tree.leaves(traced_api.variables),
+                    jax.tree.leaves(plain_api.variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    path = tmp_path / "traces" / "trace-rank0.jsonl"
+    assert path.exists()
+    events = [json.loads(l) for l in open(path)]
+    # every mesh round is on the one timeline (wrapper spans)...
+    rounds = {e["args"]["round"] for e in events
+              if e.get("name") == "round" and e.get("ph") == "X"}
+    assert rounds == {0, 1, 2, 3}
+    # ...the super-step emitted one device span per block with its range...
+    ss = [e for e in events if e.get("name") == "superstep"]
+    assert [(e["args"]["round_start"], e["args"]["round_end"]) for e in ss] \
+        == [(0, 1), (2, 3)]
+    # ...plus amortized per-round children parented under it
+    mr = [e for e in events if e.get("name") == "mesh_round"]
+    assert {e["args"]["round"] for e in mr} == {0, 1, 2, 3}
+    assert all(e["args"]["amortized"] and e.get("psid") for e in mr)
+    # compile spans attribute the program builds (shape-keyed)
+    comp = [e for e in events if e.get("cat") == "compile"]
+    assert any(e["name"].endswith(":first_call") for e in comp)
+    assert all("shape_key" in e["args"] for e in comp)
+
+
+def test_mesh_report_critical_path_compile_and_device_lane(tmp_path):
+    """ISSUE 5 acceptance: one traced cross-silo packed run (sim mesh, CPU)
+    → trace_report shows per-round critical paths for mesh rounds, compile
+    hit/miss accounting, and the --perfetto export carries a device lane."""
+    d = str(tmp_path / "tr")
+    _mesh_run(d)
+    tr = _load_trace_report()
+    events = tr.load_trace_dir(d)
+    rep = tr.analyze(events)
+    assert rep["anomalies"] == []
+    assert rep["rounds"] == 4
+    for entry in rep["timeline"]:
+        cp = entry["critical_path"]
+        assert cp["kind"] == "mesh"
+        assert cp["device_ms"] > 0 and cp["path"] == "packed_mesh"
+        assert cp["amortized"] is True
+        assert entry["device"]["superstep"] in ([0, 1], [2, 3])
+    assert [s["rounds"] for s in rep["supersteps"]] == [[0, 1], [2, 3]]
+    # compile accounting: registry counters + spans both present
+    comp = rep["compile"]
+    assert comp["counters"]["misses"] >= 2       # packed round + superstep fn
+    assert comp["counters"]["first_call_ms"] > 0
+    assert any(k.endswith(":first_call") for k in comp["spans"])
+    # device lane: sampler ran at every round boundary (CPU falls back to
+    # host RSS, so the lane exists on every backend the tests run on)
+    assert rep["device_mem"]["samples"] >= 4
+    assert rep["device_mem"]["high_water"]
+    # and the Perfetto export routes it to the dedicated devices track
+    out = str(tmp_path / "perfetto.json")
+    from fedml_tpu.obs.export import DEVICE_LANE_PID, write_chrome_trace
+
+    write_chrome_trace(out, events)
+    evs = json.load(open(out))["traceEvents"]
+    lane = [e for e in evs if e.get("pid") == DEVICE_LANE_PID]
+    assert any(e.get("ph") == "C" for e in lane)
+    assert any(e.get("ph") == "M" and e["args"]["name"] == "devices"
+               for e in lane)
+
+
+def test_sharded_mesh_rounds_traced(tmp_path):
+    """The non-packed (resident-sharded) mesh path emits per-round
+    mesh_step device spans — no amortization, real per-round boundaries."""
+    from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI
+    from fedml_tpu.models import create_model
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    d = str(tmp_path / "tr")
+    ds = make_synthetic_classification(
+        "mesh-gr", (6,), 3, 4, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=0)
+    api = CrossSiloFedAvgAPI(
+        ds, _mesh_cfg(d, pack_lanes=0, rounds_per_step=1, comm_round=2),
+        create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:]),
+        mesh=client_mesh(2))
+    api.train()
+    tr = _load_trace_report()
+    rep = tr.analyze(tr.load_trace_dir(d))
+    assert rep["anomalies"] == []
+    for entry in rep["timeline"]:
+        assert entry["critical_path"]["kind"] == "mesh"
+        assert entry["critical_path"]["amortized"] is False
+
+
+def test_mesh_gossip_rounds_traced(tmp_path):
+    """MeshDecentralizedFedAPI rides the traced wrapper too (the last
+    paradigm that used to override run_round untraced)."""
+    from fedml_tpu.algorithms.decentralized import MeshDecentralizedFedAPI
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    d = str(tmp_path / "tr")
+    ds = make_synthetic_classification(
+        "mesh-go", (6,), 3, 4, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=0)
+    cfg = FedConfig(model="lr", client_num_in_total=4, client_num_per_round=4,
+                    comm_round=2, batch_size=4, lr=0.1,
+                    frequency_of_the_test=1, trace_dir=d)
+    api = MeshDecentralizedFedAPI(ds, cfg, mesh=client_mesh(4, axis="nodes"))
+    api.train()
+    tr = _load_trace_report()
+    rep = tr.analyze(tr.load_trace_dir(d))
+    assert rep["anomalies"] == []
+    assert rep["rounds"] == 2
+    assert all(e["critical_path"]["path"] == "gossip"
+               for e in rep["timeline"])
+
+
+# -- per-host tracer identity (process_index, rank) -------------------------
+
+def test_per_host_trace_files_merge_into_one_timeline(tmp_path):
+    """Two-process layout over the local transport: each simulated HOST
+    process (distinct process_index, as parallel/mesh.py sets under
+    jax.distributed) runs a 3-rank federation into the SAME trace dir. The
+    per-host files must coexist (no clobbering) and merge into one timeline
+    with every round on every (process, rank) and no orphan recvs."""
+    d = str(tmp_path / "tr")
+    for proc in (0, 1):
+        obs.reset()
+        obs.set_process_index(proc)
+        run_fedavg_edge(_edge_ds(), _edge_cfg(trace_dir=d), worker_num=2)
+    files = sorted(os.listdir(d))
+    assert files == [
+        "trace-p1-rank0.jsonl", "trace-p1-rank1.jsonl",
+        "trace-p1-rank2.jsonl",
+        "trace-rank0.jsonl", "trace-rank1.jsonl", "trace-rank2.jsonl",
+    ]
+    tr = _load_trace_report()
+    events = tr.load_trace_dir(d)
+    rep = tr.analyze(events)
+    assert rep["anomalies"] == [], rep["anomalies"]
+    labels = {f"p{p}/r{r}" for p in (0, 1) for r in (0, 1, 2)}
+    assert set(rep["ranks"]) == labels
+    for entry in rep["timeline"]:
+        assert set(entry["ranks"]) == labels   # every host, every rank
+    # no orphan recvs across the merge: every recv's mid has its send
+    sends = {e["args"]["mid"] for e in events if e.get("name") == "send"}
+    recvs = {e["args"]["mid"] for e in events if e.get("name") == "recv"}
+    assert recvs and recvs <= sends
+
+
+# -- trace_report: registry-only dirs are "nothing to analyze" --------------
+
+def test_trace_report_registry_only_dir_exits_2(tmp_path, capsys):
+    """Regression: a trace dir holding only registry snapshots (a run that
+    flushed counters but never opened a span) used to report success with
+    an empty timeline; it must exit 2 like an empty dir."""
+    tr = _load_trace_report()
+    d = _write_trace(tmp_path, "registry_only", [
+        {"ph": "M", "name": "trace_meta", "rank": 0, "ts": 100,
+         "args": {"trace_id": "x"}},
+        {"ph": "C", "name": "registry", "cat": "registry", "ts": 101,
+         "rank": 0, "args": {"values": {"wire/sent": 3}}},
+    ])
+    assert tr.main([d]) == 2
+    # one real span flips it back to analyzable
+    with open(os.path.join(d, "trace-rank0.jsonl"), "a") as f:
+        f.write(json.dumps(
+            {"ph": "X", "name": "round", "cat": "round", "ts": 110,
+             "rank": 0, "dur": 5, "sid": 1, "args": {"round": 0}}) + "\n")
+    assert tr.main([d]) == 0
+    capsys.readouterr()

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""t1_report: digest a tier-1 pytest log into the numbers the budget cares
+about.
+
+The tier-1 gate (ROADMAP.md) runs the suite under a hard wall-clock budget
+and counts progress DOTS from the tee'd log; when the budget regresses, the
+log alone doesn't say WHERE the time went. ``tests/conftest.py`` now emits
+two machine-parseable ``[t1]`` lines at session end — per-file wall seconds
+and the XLA compile-cache hit/miss counts — and this tool parses them back
+out next to the dot count, so each PR can see its budget profile:
+
+    python tools/t1_report.py /tmp/_t1.log
+
+Report: DOTS (passed-in-window, the gate's own regex), outcome summary
+line, failure/error names, the slowest-10 test files, and the
+compile-cache line. ``--json`` emits the same as one JSON object.
+
+Exit codes: 0 parsed; 2 when the file has no pytest progress output at all
+(wrong file / empty log).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+#: the ROADMAP tier-1 gate's own progress-line shape — keep identical so
+#: this tool and the gate can never disagree about DOTS
+DOTS_RE = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+SUMMARY_RE = re.compile(
+    r"^=+ .*(passed|failed|error|no tests ran).* =+$"
+    r"|^\d+ (passed|failed|error)[^=]*in [0-9.]+m?s.*$")
+FAIL_RE = re.compile(r"^(FAILED|ERROR) (\S+)")
+FILE_SECONDS_RE = re.compile(r"^\[t1\] file-seconds: (\[.*\])\s*$")
+CACHE_RE = re.compile(r"^\[t1\] compile-cache: (.*)$")
+
+
+def parse_log(text: str) -> dict:
+    dots = 0
+    progress_lines = 0
+    failures: list[str] = []
+    summary = None
+    file_seconds: list = []
+    cache_line = None
+    for line in text.splitlines():
+        line = line.rstrip()
+        if DOTS_RE.match(line):
+            progress_lines += 1
+            dots += line.count(".")
+            continue
+        m = FAIL_RE.match(line)
+        if m:
+            failures.append(f"{m.group(1)} {m.group(2)}")
+            continue
+        if SUMMARY_RE.match(line):
+            summary = line.strip("= ")
+            continue
+        m = FILE_SECONDS_RE.match(line)
+        if m:
+            try:
+                file_seconds = json.loads(m.group(1))
+            except json.JSONDecodeError:
+                pass
+            continue
+        m = CACHE_RE.match(line)
+        if m:
+            cache_line = m.group(1)
+    return {
+        "dots": dots,
+        "progress_lines": progress_lines,
+        "summary": summary,
+        "failures": failures,
+        "slowest_files": file_seconds[:10],
+        "compile_cache": cache_line,
+    }
+
+
+def format_report(rep: dict) -> str:
+    lines = [f"tier-1 log digest: DOTS={rep['dots']}"
+             f" (over {rep['progress_lines']} progress line(s))"]
+    if rep["summary"]:
+        lines.append(f"summary: {rep['summary']}")
+    if rep["compile_cache"]:
+        lines.append(f"compile-cache: {rep['compile_cache']}")
+    if rep["slowest_files"]:
+        lines.append("slowest files (wall seconds in this session):")
+        for path, secs in rep["slowest_files"]:
+            lines.append(f"  {secs:>8.1f}s  {path}")
+    else:
+        lines.append("slowest files: not recorded (log predates the "
+                     "conftest [t1] lines, or the session was killed "
+                     "before sessionfinish)")
+    if rep["failures"]:
+        lines.append(f"failures/errors ({len(rep['failures'])}):")
+        lines.extend(f"  {f}" for f in rep["failures"])
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("log", help="tee'd tier-1 pytest log (e.g. /tmp/_t1.log)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    with open(args.log, errors="replace") as f:
+        rep = parse_log(f.read())
+    if not rep["progress_lines"] and not rep["summary"]:
+        print(f"{args.log}: no pytest progress output found", file=sys.stderr)
+        return 2
+    print(json.dumps(rep, indent=2) if args.json else format_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

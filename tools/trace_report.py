@@ -2,29 +2,42 @@
 """trace_report: merge per-rank fedtrace files into one cross-rank round
 timeline and analyze it.
 
-Input: a ``--trace_dir`` directory of ``trace-rank<r>.jsonl`` files (one
-per rank, written by fedml_tpu/obs — in-process federations write all of
-them from one process; the per-rank gRPC deployment writes one per
-process; copy them into one directory to analyze a real multi-host run).
+Input: a ``--trace_dir`` directory of per-rank trace files written by
+fedml_tpu/obs — ``trace-rank<r>.jsonl`` (single host) and/or
+``trace-p<p>-rank<r>.jsonl`` (one per HOST under jax.distributed; copy all
+hosts' files into one directory to analyze a real multi-host run). Events
+carry wall-clock µs timestamps, so per-host files align on the shared
+timebase; when multiple hosts are present, ranks are reported as
+``p<process>/r<rank>`` labels.
 
 The analyzer reconstructs causality the same way the tracer recorded it:
 every traced protocol send carries a message uid in its envelope, the recv
 span that handled it carries the same uid, so each wire edge — through the
 local/grpc/mqtt transports AND the reliable/chaos middleware, retransmits
 collapsed onto their logical message — is one (send span, recv span) pair.
+Mesh (in-mesh cross-silo / gossip) rounds have no wire legs; their
+decomposition comes from the fedscope device spans instead: ``mesh_step``
+per-round device dispatch, ``superstep`` blocks with amortized
+``mesh_round`` children, and ``compile``-category build/first-call spans.
 
 Report sections:
 - round timeline: wall-clock per round with per-rank presence,
-- critical path: per round, the slowest broadcast->train->upload->aggregate
-  chain through the span graph (which worker, and where the time went),
+- critical path: per round — the slowest broadcast->train->upload->aggregate
+  chain through the span graph for edge rounds (which worker, where the
+  time went), or the device-step decomposition for mesh rounds,
 - straggler ranking: per-rank mean end-to-end contribution,
+- compile accounting: program builds / first-call (trace+XLA) time per
+  program name, LRU hit/miss counters from the registry snapshots,
+- device memory: per-rank high-water of the round-boundary sampler lane,
 - wire anomalies: retransmits / gave_up / dup_dropped / chaos counters,
 - overlap_frac per round (host pipeline stage counters, where present).
 
 Exit codes: 0 clean; 1 structural anomalies — unclosed spans, rounds
 missing on some rank, recv spans with no matching send (span imbalance) —
-or wire gave_up; 2 nothing to analyze. ``--perfetto out.json`` exports the
-merged timeline as Chrome trace_event JSON for Perfetto.
+or wire gave_up; 2 nothing to analyze (no files, or files holding only
+registry/counter snapshots with no span graph). ``--perfetto out.json``
+exports the merged timeline as Chrome trace_event JSON for Perfetto, with
+the device-memory sampler as its own counter lane.
 """
 
 from __future__ import annotations
@@ -40,14 +53,22 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 from fedml_tpu.obs.export import read_jsonl, write_chrome_trace  # noqa: E402
 
+#: event kinds that constitute a span graph; a file with none of these
+#: (e.g. only registry snapshots) is "nothing to analyze", not a clean trace
+SPAN_PHASES = ("X", "i", "O")
+
 
 def load_trace_dir(trace_dir: str) -> list[dict]:
-    """All events from every per-rank file, sorted by timestamp."""
+    """All events from every per-(process, rank) file, sorted by timestamp."""
     events: list[dict] = []
-    for path in sorted(glob.glob(os.path.join(trace_dir, "trace-rank*.jsonl"))):
+    for path in sorted(glob.glob(os.path.join(trace_dir, "trace-*.jsonl"))):
         events.extend(read_jsonl(path))
     events.sort(key=lambda e: e.get("ts", 0))
     return events
+
+
+def has_span_events(events: list[dict]) -> bool:
+    return any(e.get("ph") in SPAN_PHASES for e in events)
 
 
 def _args(ev: dict) -> dict:
@@ -56,20 +77,37 @@ def _args(ev: dict) -> dict:
 
 def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
     """Structure the merged events; returns the full report dict."""
-    rounds: dict[int, dict[int, dict]] = defaultdict(dict)  # round -> rank -> span
+    # multi-host traces label ranks p<process>/r<rank>; single-host traces
+    # keep plain int ranks (the shape every existing consumer pins)
+    multi = any(e.get("proc") for e in events)
+
+    def rid(ev: dict):
+        r = int(ev.get("rank", 0))
+        return f"p{int(ev.get('proc', 0))}/r{r}" if multi else r
+
+    rounds: dict[int, dict[object, dict]] = defaultdict(dict)  # round -> rank -> span
     sends: dict[str, dict] = {}
     recvs: dict[str, dict] = {}
     retransmits: list[dict] = []
     chaos_drops = 0
     unclosed: list[dict] = []
-    counters: dict[int, dict] = {}
+    counters: dict[object, dict] = {}
     stage_rows: dict[int, dict] = {}
     span_by_sid: dict[tuple, dict] = {}
-    ranks: set[int] = set()
+    ranks: set = set()
+    # fedscope device/compile lanes
+    #: round -> rank -> mesh decomposition (per-rank: a merged multi-host
+    #: trace has every host running the same mesh round — summing across
+    #: hosts would double-count device time)
+    device_rows: dict[int, dict] = {}
+    supersteps: list[dict] = []
+    compile_spans: dict[str, dict] = {}   # program name -> {count, ms}
+    device_mem: dict[object, dict] = {}   # rank -> series -> high-water
+    device_mem_samples = 0
 
     for ev in events:
         ph, name = ev.get("ph"), ev.get("name")
-        rank = int(ev.get("rank", 0))
+        rank = rid(ev)
         if ph != "M":
             ranks.add(rank)
         if ph == "O":
@@ -92,6 +130,31 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
                 m = _args(ev).get("mid")
                 if m:
                     recvs[m] = ev
+            elif ev.get("cat") == "device" and name in ("mesh_step",
+                                                        "mesh_round"):
+                r = _args(ev).get("round")
+                if r is not None:
+                    row = device_rows.setdefault(int(r), {}).setdefault(
+                        rank, {"device_ms": 0.0, "spans": 0})
+                    row["device_ms"] += ev.get("dur", 0) / 1e3
+                    row["spans"] += 1
+                    if _args(ev).get("path"):
+                        row["path"] = _args(ev)["path"]
+                    if _args(ev).get("amortized"):
+                        row["amortized"] = True
+                        row["superstep"] = _args(ev).get("superstep")
+            elif ev.get("cat") == "device" and name == "superstep":
+                a = _args(ev)
+                supersteps.append({
+                    "rounds": [a.get("round_start"), a.get("round_end")],
+                    "h": a.get("h"),
+                    "wall_ms": round(ev.get("dur", 0) / 1e3, 3),
+                    "rank": rank,
+                })
+            elif ev.get("cat") == "compile":
+                row = compile_spans.setdefault(name, {"count": 0, "ms": 0.0})
+                row["count"] += 1
+                row["ms"] += ev.get("dur", 0) / 1e3
         elif ph == "i":
             if name == "retransmit":
                 retransmits.append(ev)
@@ -110,13 +173,19 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
                 r = _args(ev).get("round")
                 if r is not None:
                     stage_rows[int(r)] = _args(ev).get("values") or {}
+            elif name == "device_mem":
+                vals = _args(ev).get("values") or {}
+                dst = device_mem.setdefault(rank, {})
+                for k, v in vals.items():
+                    dst[k] = max(dst.get(k, 0), v)
+                device_mem_samples += 1
 
     # -- structural checks -------------------------------------------------
     anomalies: list[str] = []
     if unclosed:
         for ev in unclosed[:8]:
             anomalies.append(
-                f"unclosed span {ev.get('name')!r} on rank {ev.get('rank')}"
+                f"unclosed span {ev.get('name')!r} on rank {rid(ev)}"
                 f" (args={_args(ev)})")
         if len(unclosed) > 8:
             anomalies.append(f"... and {len(unclosed) - 8} more unclosed spans")
@@ -138,6 +207,12 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
     for snap in counters.values():
         for k, v in snap.items():
             wire_total[k] = wire_total.get(k, 0) + v
+    # the compile group is process-wide (owned by rank 0): split it out of
+    # the wire summary into its own section
+    compile_counters = {k.split("/", 1)[1]: v for k, v in wire_total.items()
+                        if k.startswith("compile/")}
+    wire_total = {k: v for k, v in wire_total.items()
+                  if not k.startswith("compile/")}
     if wire_total.get("wire/gave_up", 0):
         anomalies.append(
             f"wire gave_up={wire_total['wire/gave_up']}: message(s) "
@@ -148,10 +223,10 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
              default=0)
     # upload lookup for _worker_chain: (worker rank, parent round span) ->
     # send span, so chain walks don't rescan every send per worker
-    sends_by_parent = {(int(s.get("rank", -1)), s["psid"]): s
+    sends_by_parent = {(rid(s), s["psid"]): s
                        for s in sends.values() if s.get("psid")}
     timeline = []
-    stragglers: dict[int, list[float]] = defaultdict(list)
+    stragglers: dict[object, list[float]] = defaultdict(list)
     for r in sorted(rounds):
         per = rounds[r]
         start = min(e["ts"] for e in per.values())
@@ -180,6 +255,32 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
             entry["critical_path"] = {"worker_rank": best_rk, **chains[best_rk]}
             for rk, chain in chains.items():
                 stragglers[rk].append(chain["total_ms"])
+        per_rank_dev = device_rows.get(r)
+        if per_rank_dev:
+            # critical-path semantics across hosts: the round is gated by
+            # the SLOWEST host's device step, not the sum over hosts
+            slow_rk = max(per_rank_dev, key=lambda k: per_rank_dev[k]["device_ms"])
+            dev = per_rank_dev[slow_rk]
+            entry["device"] = {
+                "device_ms": round(dev["device_ms"], 3),
+                "path": dev.get("path"),
+                "amortized": bool(dev.get("amortized")),
+                **({"rank": slow_rk} if len(per_rank_dev) > 1 else {}),
+                **({"superstep": dev["superstep"]}
+                   if dev.get("superstep") else {}),
+            }
+            if "critical_path" not in entry:
+                # mesh rounds: no wire legs — the critical path IS the
+                # device step (host residual = round wall minus device)
+                entry["critical_path"] = {
+                    "kind": "mesh",
+                    "device_ms": entry["device"]["device_ms"],
+                    "host_ms": round(
+                        max(entry["wall_ms"]
+                            - entry["device"]["device_ms"], 0.0), 3),
+                    "path": dev.get("path"),
+                    "amortized": bool(dev.get("amortized")),
+                }
         if r in stage_rows:
             row = stage_rows[r]
             host = row.get("materialize_ms", 0) + row.get("h2d_ms", 0)
@@ -194,7 +295,7 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
           "rounds": len(v)} for rk, v in stragglers.items()),
         key=lambda x: -x["mean_chain_ms"])
 
-    return {
+    rep = {
         "ranks": sorted(ranks),
         "rounds": len(rounds),
         "events": len(events),
@@ -207,9 +308,24 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
         },
         "anomalies": anomalies,
     }
+    if compile_spans or compile_counters:
+        rep["compile"] = {
+            "counters": compile_counters,
+            "spans": {k: {"count": v["count"], "ms": round(v["ms"], 3)}
+                      for k, v in sorted(compile_spans.items())},
+        }
+    if supersteps:
+        rep["supersteps"] = supersteps
+    if device_mem:
+        rep["device_mem"] = {
+            "samples": device_mem_samples,
+            "high_water": {str(rk): dict(sorted(v.items()))
+                           for rk, v in device_mem.items()},
+        }
+    return rep
 
 
-def _worker_chain(round_span: dict, rank: int, span_by_sid, sends,
+def _worker_chain(round_span: dict, rank, span_by_sid, sends,
                   sends_by_parent, recvs):
     """One worker's causal chain for a round, in ms. Returns None when the
     linkage is incomplete (e.g. an untraced peer)."""
@@ -247,18 +363,54 @@ def format_report(rep: dict) -> str:
             row += f"  overlap {e['overlap_frac']:.2f}"
         lines.append(row)
         cp = e.get("critical_path")
-        if cp:
+        if cp and cp.get("kind") == "mesh":
+            amort = " (amortized)" if cp.get("amortized") else ""
+            lines.append(
+                f"        critical: device {cp['device_ms']:.1f} ms"
+                f" [{cp.get('path')}]{amort}"
+                f" + host {cp['host_ms']:.1f} ms")
+        elif cp:
             lines.append(
                 f"        critical: worker {cp['worker_rank']} "
                 f"{cp['total_ms']:.1f} ms = down {cp['wire_down_ms']:.1f}"
                 f" + train {cp['train_ms']:.1f}"
                 f" + up {cp['wire_up_ms']:.1f}")
+    if rep.get("supersteps"):
+        lines.append("")
+        lines.append("super-steps (one device program per block; per-round "
+                     "attribution above is amortized):")
+        for s in rep["supersteps"]:
+            lines.append(
+                f"  rounds {s['rounds'][0]}..{s['rounds'][1]}  "
+                f"wall {s['wall_ms']:.1f} ms  (h={s['h']}, rank {s['rank']})")
     if rep["straggler_ranking"]:
         lines.append("")
         lines.append("straggler ranking (mean causal-chain ms, worst first):")
         for s in rep["straggler_ranking"]:
-            lines.append(f"  rank {s['rank']:>3}  {s['mean_chain_ms']:>9.1f} ms"
+            lines.append(f"  rank {s['rank']!s:>6}  "
+                         f"{s['mean_chain_ms']:>9.1f} ms"
                          f"  over {s['rounds']} round(s)")
+    comp = rep.get("compile")
+    if comp and (comp["counters"] or comp["spans"]):
+        c = comp["counters"]
+        lines.append("")
+        lines.append(
+            "compile accounting: "
+            f"{c.get('misses', 0)} build(s) / {c.get('hits', 0)} cache "
+            f"hit(s), build {c.get('build_ms', 0.0):.1f} ms, first-call "
+            f"(trace+XLA) {c.get('first_call_ms', 0.0):.1f} ms")
+        for name, row in comp["spans"].items():
+            lines.append(f"  {name}: {row['count']} span(s), "
+                         f"{row['ms']:.1f} ms")
+    dm = rep.get("device_mem")
+    if dm:
+        lines.append("")
+        lines.append(f"device memory (high-water over {dm['samples']} "
+                     "round-boundary samples):")
+        for rk, series in dm["high_water"].items():
+            parts = ", ".join(f"{k}={v / 1e6:.1f} MB"
+                              for k, v in series.items())
+            lines.append(f"  rank {rk}: {parts}")
     wire = {k: v for k, v in rep["wire"].items() if v}
     if wire:
         lines.append("")
@@ -286,7 +438,15 @@ def main(argv=None) -> int:
 
     events = load_trace_dir(args.trace_dir)
     if not events:
-        print(f"no trace-rank*.jsonl events under {args.trace_dir}",
+        print(f"no trace-*.jsonl events under {args.trace_dir}",
+              file=sys.stderr)
+        return 2
+    if not has_span_events(events):
+        # a run can flush registry snapshots without ever opening a span
+        # (e.g. counters-only instrumentation); there is no span graph to
+        # analyze, and pretending the trace is "clean" would mask the gap
+        print(f"no span events under {args.trace_dir} (only "
+              "registry/counter snapshots); nothing to analyze",
               file=sys.stderr)
         return 2
     rep = analyze(events, expect_ranks=args.expect_ranks)
