@@ -85,7 +85,18 @@ class FedAvgAPI:
         self._local_train = self.build_local_train()
         self._eval = make_eval_fn(self.bundle, self.task)
         self.server_state = self.init_server_state()
-        self._round_step = self.build_round_step()
+        # the default (host-cohort) round program rides the same fedscope
+        # compile telemetry + fedcost attribution hook as the packed/
+        # grouped/gather programs — a vanilla run is not a blind spot.
+        # Subclass paradigms build a DIFFERENT program from the same
+        # __init__, so their records are name-qualified: one process running
+        # several API types (bench.py) keeps one attribution per program
+        # instead of latest-wins overwrites under a shared "round_step".
+        from fedml_tpu.obs import timed_build
+
+        self._round_step = timed_build(
+            self._program_name("round_step"), ("default",),
+            self.build_round_step)
         self._dev_train = self._maybe_place_train_data()
         self._gather_steps: dict[int, Callable] = {}
         self._group_steps: dict[tuple, Callable] = {}
@@ -97,7 +108,9 @@ class FedAvgAPI:
         #: per-round stage timings for utils/metrics.round_stats (host path)
         self._stage_rows: deque = deque(maxlen=1024)
         if self._dev_train is not None:
-            self._round_step_gather = self.build_round_step_gather()
+            self._round_step_gather = timed_build(
+                self._program_name("gather_step"), ("full",),
+                self.build_round_step_gather)
         self.history: dict[str, list] = {"round": [], "Test/Acc": [], "Test/Loss": []}
 
     def _maybe_place_train_data(self):
@@ -381,6 +394,15 @@ class FedAvgAPI:
 
         return round_step
 
+    def _program_name(self, base: str) -> str:
+        """Telemetry/attribution name for a round program built in the
+        shared ``__init__``: subclasses build a DIFFERENT program from the
+        same code path, so qualify by class. Base-class instances keep the
+        bare name (existing counter keys and goldens unchanged)."""
+        if type(self) is FedAvgAPI:
+            return base
+        return f"{base}.{type(self).__name__}"
+
     def _lru_step(self, cache: dict, key, builder, name: str, cap: int = 64):
         """Shared LRU for compiled round programs (group/packed schedules):
         bound the cache — with failure injection the per-round plan varies
@@ -395,6 +417,10 @@ class FedAvgAPI:
         build + first-call spans keyed by the program's shape key."""
         from fedml_tpu.obs import record_cache_hit, timed_build
 
+        # class-qualified like the __init__-built programs: a subclass's
+        # packed/group/gather program is a different program and must not
+        # overwrite the base class's attribution record or merge counters
+        name = self._program_name(name)
         step = cache.get(key)
         if step is None:
             if len(cache) >= cap:
@@ -662,7 +688,11 @@ class FedAvgAPI:
                 or type(self).build_round_step is not FedAvgAPI.build_round_step):
             return self._round_step
         if self._donated_step is None:
-            jitted = jax.jit(self._round_body, donate_argnums=(2, 3, 4))
+            from fedml_tpu.obs import timed_build
+
+            jitted = timed_build(
+                self._program_name("donated_step"), ("donated",),
+                lambda: jax.jit(self._round_body, donate_argnums=(2, 3, 4)))
 
             def step(*args):
                 with warnings.catch_warnings():

@@ -217,6 +217,15 @@ class FedConfig:
     # ring-buffer bound per rank: oldest events fall off instead of
     # growing the heap on a weeks-long federation
     trace_buffer_events: int = 65536
+    # fedcost static roofline attribution (obs/cost, DESIGN.md §13): when
+    # on, every round program built through obs/compile.timed_build is
+    # ALSO lowered to HLO and read back as a per-op GEMM table (conv/dot
+    # M/K/N shapes, FLOPs, MXU lane fills, flop-weighted lane ceiling),
+    # stored process-wide (obs.cost_tables()) and — under tracing — emitted
+    # as a "program_cost" event for tools/trace_report.py's cost section.
+    # Pure static analysis: one extra trace per program build (no compile,
+    # no device sync), numerics bit-identical on or off.
+    cost_attribution: bool = False
     # fedscope device-memory sampler: when tracing is on, snapshot
     # jax.local_devices() memory_stats (bytes_in_use + peak watermark) at
     # every round boundary into a "device" counter lane (one allocator read
@@ -457,6 +466,12 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                    default=defaults.trace_device_sampler,
                    help="sample per-device memory at round boundaries into "
                         "the trace's device lane (0|1; traced runs only)")
+    p.add_argument("--cost_attribution", type=lambda s: bool(int(s)),
+                   default=defaults.cost_attribution,
+                   help="fedcost static roofline attribution of every built "
+                        "round program (0|1): per-op GEMM/lane-fill table "
+                        "via obs/cost; report with tools/trace_report.py or "
+                        "tools/roofline_report.py")
     p.add_argument("--run_name", type=str, default=defaults.run_name)
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_frequency", type=int, default=defaults.checkpoint_frequency)

@@ -23,6 +23,11 @@ assumes (arXiv:2303.01778):
 - :mod:`fedml_tpu.obs.device` (fedscope) — device-memory sampler at round
   boundaries; a "devices" counter lane in the Perfetto export without a
   separate ``--profile_dir`` profiler run.
+- :mod:`fedml_tpu.obs.cost` (fedcost) — static per-op roofline
+  attribution: every round program built through ``timed_build`` can be
+  lowered to HLO and read back as a GEMM table (M/K/N, FLOPs, MXU lane
+  fills) with a flop-weighted lane ceiling per program; also the single
+  shared peak-FLOPs table behind every MFU number.
 
 Tracing is OFF by default and enabled per run via ``--trace_dir``
 (core/config.py). The contract: a traced run is bit-identical to an
@@ -30,6 +35,14 @@ untraced run — the tracer only ever reads clocks.
 """
 
 from fedml_tpu.obs.compile import compile_counters, record_cache_hit, timed_build
+from fedml_tpu.obs.cost import (
+    cost_attribution_enabled,
+    cost_tables,
+    enable_cost_attribution,
+    fwd_flops_per_image,
+    peak_flops,
+    reset_cost_tables,
+)
 from fedml_tpu.obs.device import sample_device_memory
 from fedml_tpu.obs.registry import (
     CounterGroup,
@@ -56,7 +69,13 @@ __all__ = [
     "compile_counters",
     "configure",
     "configure_from",
+    "cost_attribution_enabled",
+    "cost_tables",
     "default_registry",
+    "enable_cost_attribution",
+    "fwd_flops_per_image",
+    "peak_flops",
+    "reset_cost_tables",
     "flush_all",
     "get_tracer",
     "record_cache_hit",

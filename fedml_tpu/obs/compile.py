@@ -63,8 +63,6 @@ def timed_build(name: str, shape_key, builder: Callable) -> Callable:
     timed and attributed too. ``shape_key`` is recorded (repr'd) on the
     spans so a report can say WHICH program shape cost the time."""
     g = compile_counters()
-    g["misses"] = g.get("misses", 0) + 1
-    g[f"misses.{name}"] = g.get(f"misses.{name}", 0) + 1
     tr = tracer_if_enabled(0)
     t0 = time.perf_counter()
     if tr is None:
@@ -73,6 +71,11 @@ def timed_build(name: str, shape_key, builder: Callable) -> Callable:
         with tr.span(f"{name}:build", cat="compile",
                      args={"shape_key": repr(shape_key)}):
             fn = builder()
+    # counters bump only once the builder has RETURNED a program: a raising
+    # builder propagates with no partial misses/build_ms entry (the caller's
+    # LRU never stores the step, so a retry is a fresh build, counted once)
+    g["misses"] = g.get("misses", 0) + 1
+    g[f"misses.{name}"] = g.get(f"misses.{name}", 0) + 1
     g["build_ms"] = g.get("build_ms", 0.0) + (time.perf_counter() - t0) * 1e3
 
     first = [True]
@@ -80,7 +83,6 @@ def timed_build(name: str, shape_key, builder: Callable) -> Callable:
     def step(*args):
         if not first[0]:
             return fn(*args)
-        first[0] = False
         tr = tracer_if_enabled(0)
         t0 = time.perf_counter()
         if tr is None:
@@ -89,8 +91,23 @@ def timed_build(name: str, shape_key, builder: Callable) -> Callable:
             with tr.span(f"{name}:first_call", cat="compile",
                          args={"shape_key": repr(shape_key)}):
                 out = fn(*args)
+        # only a SUCCESSFUL first call records first_call_ms: a raise
+        # propagates, the flag stays set, and the next invocation is timed
+        # as the first (the compile genuinely happens on whichever call
+        # completes). The :first_call SPAN above does close on the failed
+        # attempt — deliberately: spans record attempts (the time was truly
+        # spent), counters record successful compile accounting, so after a
+        # retry a trace may carry more first_call spans than the counter.
+        first[0] = False
         g["first_call_ms"] = g.get("first_call_ms", 0.0) + (
             time.perf_counter() - t0) * 1e3
+        # fedcost static attribution (obs/cost): lower the program we just
+        # paid to compile and record its per-op roofline table. Pure
+        # tracing — no second compile, no sync — and only when enabled.
+        from fedml_tpu.obs import cost as _cost
+
+        if _cost.cost_attribution_enabled():
+            _cost.attribute_program(name, shape_key, fn, args)
         return out
 
     # the packed mesh round carries its un-jitted body as `.raw` (the

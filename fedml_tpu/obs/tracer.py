@@ -364,6 +364,11 @@ def configure_from(config) -> bool:
     DISABLES tracing left on by an earlier run in the same process (its
     events would otherwise append into the previous run's trace files).
     Only a config without the attribute at all leaves tracing untouched."""
+    # fedcost rides the same entry-point hook: a config carrying
+    # cost_attribution configures static roofline attribution here too
+    from fedml_tpu.obs import cost as _cost
+
+    _cost.configure_from(config)
     trace_dir = getattr(config, "trace_dir", _NO_TRACE_DIR)
     if trace_dir is _NO_TRACE_DIR:
         return tracing_enabled()

@@ -1,0 +1,154 @@
+"""tools/bench_report.py: the BENCH_r*.json trajectory + regression gate.
+
+This doubles as the tier-1 smoke over the COMMITTED artifacts (ISSUE 6
+satellite): the repo's own bench series must parse, print a trajectory and
+exit 0 — so a PR that breaks the artifact schema (or regresses the tail
+the driver captures next round) fails here, not silently.
+
+Pure-text tests: no jax import, no model build — safe at any point in the
+tier-1 budget.
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "bench_report", os.path.join(REPO, "tools", "bench_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+br = _load()
+
+COMMITTED = sorted(
+    os.path.join(REPO, f) for f in os.listdir(REPO)
+    if f.startswith("BENCH_r") and f.endswith(".json"))
+
+
+def test_committed_artifacts_exist():
+    assert len(COMMITTED) >= 5, COMMITTED
+
+
+def test_committed_series_parses_and_exits_0(capsys):
+    rc = br.main(COMMITTED)
+    out = capsys.readouterr()
+    assert rc == 0, out.err
+    # the trajectory table carries every run and the headline columns
+    assert "r01" in out.out and "r05" in out.out
+    assert "vs_baseline" in out.out and "mfu" in out.out
+
+
+def test_committed_series_check_mode(capsys):
+    rc = br.main(["--dir", REPO, "--check"])
+    out = capsys.readouterr()
+    assert rc == 0, out.err
+    assert "0 regression(s)" in out.out
+
+
+def test_committed_trajectory_values():
+    """Pin the parsed trajectory itself: the committed series IS the
+    baseline the gate compares future artifacts against."""
+    rows = br.load_series(COMMITTED)
+    assert [r["n"] for r in rows] == [1, 2, 3, 4, 5]
+    traj = {r["n"]: r for r in rows}
+    assert traj[1]["vs_baseline"] == pytest.approx(1.6)
+    assert traj[1]["mfu"] is None          # mfu starts at r02
+    assert traj[5]["vs_baseline"] == pytest.approx(2.333)
+    assert traj[5]["mfu"] == pytest.approx(0.1046)
+    assert traj[5]["clients_per_sec"] == pytest.approx(46.83)
+    assert traj[4]["crosssilo_img_per_sec"] == pytest.approx(30466.5)
+
+
+def _regressed_copy(tmp_path, metric_mutator):
+    """Copy the committed artifacts, mutate r05's bench line."""
+    for p in COMMITTED:
+        shutil.copy(p, tmp_path / os.path.basename(p))
+    p5 = tmp_path / "BENCH_r05.json"
+    art = json.loads(p5.read_text())
+    lines = art["tail"].splitlines()
+    for i, line in enumerate(lines):
+        s = line.strip()
+        if s.startswith("{") and "metric" in s:
+            bench = json.loads(s)
+            metric_mutator(bench)
+            lines[i] = json.dumps(bench)
+    art["tail"] = "\n".join(lines)
+    p5.write_text(json.dumps(art))
+    return [str(tmp_path / os.path.basename(p)) for p in COMMITTED]
+
+
+def test_mfu_drop_over_threshold_exits_1(tmp_path, capsys):
+    def drop_mfu(bench):
+        bench["mfu"] = round(bench["mfu"] * 0.85, 4)   # -15% > 10% threshold
+
+    rc = br.main(_regressed_copy(tmp_path, drop_mfu))
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "REGRESSION" in out.err and "mfu" in out.err
+
+
+def test_vs_baseline_drop_over_threshold_exits_1(tmp_path, capsys):
+    def drop_vs(bench):
+        bench["vs_baseline"] = round(bench["vs_baseline"] * 0.8, 3)
+        bench["value"] = round(bench["value"] * 0.8, 1)
+
+    rc = br.main(_regressed_copy(tmp_path, drop_vs))
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "vs_baseline" in out.err
+
+
+def test_small_drop_within_threshold_exits_0(tmp_path, capsys):
+    def nudge(bench):
+        bench["mfu"] = round(bench["mfu"] * 0.95, 4)   # -5% < 10%
+
+    rc = br.main(_regressed_copy(tmp_path, nudge))
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_empty_dir_exits_2(tmp_path, capsys):
+    rc = br.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr()
+    assert rc == 2
+    assert "no artifacts" in out.err
+
+
+def test_malformed_artifacts_exit_2(tmp_path, capsys):
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({"tail": "no bench"}))
+    rc = br.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr()
+    assert rc == 2
+    assert "no parseable" in out.err
+
+
+def test_tail_last_json_line_wins(tmp_path):
+    """A retried bench run prints two JSON lines; the LAST is the
+    artifact (bench.py's retry path)."""
+    art = {"n": 9, "tail": "\n".join([
+        json.dumps({"metric": "x", "value": 1.0, "vs_baseline": 0.1}),
+        "Traceback: transient INTERNAL",
+        json.dumps({"metric": "x", "value": 5.0, "vs_baseline": 0.5}),
+    ])}
+    p = tmp_path / "BENCH_r09.json"
+    p.write_text(json.dumps(art))
+    n, bench = br.parse_artifact(str(p))
+    assert n == 9 and bench["value"] == 5.0
+
+
+def test_missing_metric_never_pairs_across_gaps():
+    """clients_per_sec exists only in r05 — one point, no comparison, no
+    spurious regression."""
+    rows = br.load_series(COMMITTED)
+    regs = br.detect_regressions(rows, threshold=0.10)
+    assert regs == []

@@ -87,6 +87,8 @@ def test_resnet_lanes_param_tree_identical():
             == jtu.tree_map(lambda a: a.shape, v2))
 
 
+@pytest.mark.slow  # 44 s of interpret-mode lanes-kernel runtime (ISSUE 6);
+# kernel-level parity stays gated via test_grads_match_xla
 def test_resnet_lanes_model_parity():
     """Same params -> same logits / grads / batch stats (float-order
     tolerance: the kernel sums taps in a different association, which
@@ -120,6 +122,7 @@ def test_resnet_lanes_model_parity():
         np.testing.assert_allclose(a, b, rtol=0, atol=5e-3)
 
 
+@pytest.mark.slow  # 19 s: packed-round program with the interpret-mode kernel
 def test_lanes_rides_fedavg_round():
     """The lanes model must run through the packed federated round program
     (vmap over lanes + lax.scan over steps) unchanged."""

@@ -15,6 +15,11 @@ from fedml_tpu.data.synthetic import make_synthetic_classification
 from fedml_tpu.models import create_model
 from fedml_tpu.parallel.mesh import client_mesh
 
+# 132 s of 8-device-mesh zoo parity compiles — #3 in the tier-1
+# file-seconds top-10; excluded from the 870 s gate (ISSUE 6). The fast
+# per-algorithm simulation coverage stays in test_algorithms/test_crosssilo.
+pytestmark = pytest.mark.slow
+
 C = 8  # clients == mesh devices
 
 

@@ -43,6 +43,7 @@ def test_launcher_vfl(capsys):
     assert "Test/Acc" in blob and blob["Test/Acc"] > 0.5
 
 
+@pytest.mark.slow  # 59 s: two-model GKT protocol run (tier-1 tail, ISSUE 6)
 def test_launcher_fedgkt():
     cfg = FedConfig(
         model="lr", dataset="synthetic_1_1", client_num_in_total=2,
@@ -84,6 +85,7 @@ def test_dispatcher_covers_crosssilo(algo):
     assert isinstance(out, dict) and out
 
 
+@pytest.mark.slow  # 244 s: structured-mesh zoo compiles (tier-1 tail, ISSUE 6)
 def test_dispatcher_covers_crosssilo_structured():
     """The structured mesh algorithms (VERDICT r2 #5) drive through the
     unified dispatcher end-to-end on the 8-device virtual mesh (the cohort
@@ -121,6 +123,7 @@ def test_dispatcher_covers_splitnn():
     assert isinstance(out, dict) and out
 
 
+@pytest.mark.slow  # 100 s: DARTS search + fedseg runs (tier-1 tail, ISSUE 6)
 def test_dispatcher_covers_fednas_and_fedseg_and_nothing_is_missed():
     """Close the loop on 'every algorithm drives through the dispatcher':
     fednas + fedseg smoke here, and a completeness assertion derived from
@@ -198,3 +201,10 @@ def test_bench_tiny_smoke(monkeypatch, capsys):
     assert out["value"] > 0
     # XLA cost-model FLOP accounting must be live (mfu itself is None off-TPU)
     assert out["model_flops_per_image"] and out["model_flops_per_image"] > 0
+    # fedcost roofline block (ISSUE 6): the tail must carry the per-program
+    # static lane table — a silently-failing attribution regresses here
+    roof = out["roofline"]
+    assert roof and roof["programs"], roof
+    prog = next(iter(roof["programs"].values()))
+    assert prog["gemm_gflops_per_invocation"] > 0
+    assert prog["out_lane_ceiling"] is not None

@@ -17,6 +17,12 @@ import fedml_tpu.distributed.fedgkt_edge as fe
 from fedml_tpu.core.config import FedConfig
 from fedml_tpu.data.synthetic import make_synthetic_classification
 
+# ~50-65 s of real straggler-deadline waits (each test runs 2+ threaded
+# federations against wall-clock deadlines) — tier-1 file-seconds top-10,
+# excluded from the 870 s gate (ISSUE 6). The deadline logic itself stays
+# gated via test_edge_failures / test_edge_ft_protocols.
+pytestmark = pytest.mark.slow
+
 C = 3
 
 

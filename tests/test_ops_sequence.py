@@ -19,6 +19,11 @@ from fedml_tpu.ops.attention import (
 )
 from fedml_tpu.ops.xent import masked_cross_entropy
 
+# 88 s of pallas-interpret kernels — tier-1 file-seconds top-10 — and the
+# known jax-0.4.37 pallas/ring/ulysses failures live here; excluded from
+# the 870 s gate (ISSUE 6). Run explicitly when touching ops/.
+pytestmark = pytest.mark.slow
+
 
 def naive_attention(q, k, v, causal=True):
     d = q.shape[-1]

@@ -142,10 +142,23 @@ def test_cross_rank_stitch_grpc_4_ranks(tmp_path):
     _assert_stitched(d, n_ranks=4, n_rounds=2)
 
 
-def test_retransmits_tagged_with_message_uid(tmp_path):
+def test_retransmits_tagged_with_message_uid(tmp_path, monkeypatch):
     """Chaos drops force retransmits; the retransmit instants carry the SAME
     uid as the original send span, so the analyzer collapses the storm onto
     one logical edge and still stitches every round."""
+    import functools
+
+    from fedml_tpu.comm import reliable as rel
+
+    # deep retry budget: the default 10-retry schedule exhausts in ~6.6 s,
+    # which a compile/GC stall on the shared 2-vCPU tier-1 box can exceed
+    # late in the suite — a gave_up here would fail the stitch assertion
+    # for scheduler reasons, not wire-logic reasons. Patience changes no
+    # semantics: acks land in ms whenever the peer thread is scheduled.
+    monkeypatch.setattr(
+        rel.ReliableCommManager, "__init__",
+        functools.partialmethod(rel.ReliableCommManager.__init__,
+                                retry_max=40, drain_timeout_s=30.0))
     d = str(tmp_path / "tr")
     cfg = _edge_cfg(trace_dir=d, wire_reliable=True, chaos_drop=0.2,
                     chaos_seed=7)
@@ -639,3 +652,58 @@ def test_trace_report_registry_only_dir_exits_2(tmp_path, capsys):
              "rank": 0, "dur": 5, "sid": 1, "args": {"round": 0}}) + "\n")
     assert tr.main([d]) == 0
     capsys.readouterr()
+
+
+# -- fedscope timed_build: counter consistency on failure --------------------
+
+def test_timed_build_raising_builder_records_nothing():
+    """Regression (ISSUE 6): a builder that raises must not leave a partial
+    misses/build_ms entry — the caller's LRU never stores the step, so a
+    retry is a fresh build that must count exactly once."""
+    from fedml_tpu.obs import compile_counters, timed_build
+
+    g = compile_counters()
+    before = g.as_dict()
+
+    def boom():
+        raise RuntimeError("builder exploded")
+
+    with pytest.raises(RuntimeError, match="builder exploded"):
+        timed_build("t1_raise_build", ("k",), boom)
+    assert g.as_dict() == before, "partial counter entry after failed build"
+
+    # the retry (a working builder) counts exactly one miss
+    step = timed_build("t1_raise_build", ("k",), lambda: (lambda x: x + 1))
+    assert g.get("misses.t1_raise_build", 0) == \
+        before.get("misses.t1_raise_build", 0) + 1
+    assert g.get("misses", 0) == before.get("misses", 0) + 1
+    assert step(2) == 3
+
+
+def test_timed_build_raising_first_call_retimed_not_recorded():
+    """A first invocation that raises (failed trace/compile) propagates
+    with no first_call_ms recorded; the NEXT invocation — where the
+    compile genuinely happens — is timed as the first call."""
+    from fedml_tpu.obs import compile_counters, timed_build
+
+    g = compile_counters()
+    calls = {"n": 0}
+
+    def fn(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("first call dies in trace")
+        return x * 2
+
+    step = timed_build("t1_raise_first", ("k",), lambda: fn)
+    before_fc = g.get("first_call_ms", 0.0)
+    with pytest.raises(ValueError, match="first call dies"):
+        step(3)
+    assert g.get("first_call_ms", 0.0) == before_fc, \
+        "first_call_ms recorded for a raising first call"
+    assert step(3) == 6                       # retry succeeds...
+    assert g.get("first_call_ms", 0.0) > before_fc   # ...and IS the compile
+    assert step(4) == 8                       # steady state: no re-timing
+    after = g.get("first_call_ms", 0.0)
+    step(5)
+    assert g.get("first_call_ms", 0.0) == after

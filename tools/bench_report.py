@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""bench_report: the BENCH_r*.json series as a trajectory + regression gate.
+
+Five driver-captured bench artifacts sit in the repo with no tool that
+reads them — a throughput or MFU regression between PRs would ship
+silently. This tool parses the series (each artifact's ``tail`` field
+holds the one-line bench JSON; the pre-parsed ``parsed`` key is the
+fallback) into a per-round trajectory table of the headline metrics:
+
+    python tools/bench_report.py BENCH_r*.json
+    python tools/bench_report.py --dir .          # same, globbed
+    python tools/bench_report.py --dir . --check  # gate mode (tier-1 smoke)
+
+and applies thresholded regression detection: for each tracked metric, the
+LAST artifact that carries it is compared against the PREVIOUS artifact
+that carries it; a drop of more than ``--threshold`` (default 10%) is a
+regression. Metrics appear and disappear across the series (mfu starts at
+r02, crossdevice at r05) — comparison only ever pairs artifacts where the
+metric is present.
+
+Exit codes: 0 trajectory clean; 1 regression(s) detected (listed on
+stderr); 2 nothing to analyze — no artifacts, or none parseable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: metric -> (extractor over the bench JSON, short label). Every metric is
+#: higher-is-better; regression = relative drop beyond the threshold.
+METRICS = {
+    "img_per_sec": (lambda j: j.get("value"), "flagship img/s"),
+    "vs_baseline": (lambda j: j.get("vs_baseline"), "vs_baseline"),
+    "mfu": (lambda j: j.get("mfu"), "mfu"),
+    "crosssilo_img_per_sec": (
+        lambda j: (j.get("crosssilo") or {}).get("images_per_sec"),
+        "cross-silo img/s"),
+    "clients_per_sec": (
+        lambda j: (j.get("crossdevice") or {}).get("clients_per_sec"),
+        "cross-device clients/s"),
+}
+
+_RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def parse_artifact(path: str):
+    """One BENCH artifact -> (run number, bench-JSON dict) or None when the
+    file is unreadable/malformed. The authoritative source is the LAST
+    JSON line of the ``tail`` field (the bench's own stdout through the
+    TPU-host tunnel); ``parsed`` is accepted as fallback."""
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(art, dict):
+        return None
+    n = art.get("n")
+    if n is None:
+        m = _RUN_RE.search(os.path.basename(path))
+        n = int(m.group(1)) if m else None
+    bench = None
+    tail = art.get("tail")
+    if isinstance(tail, str):
+        for line in tail.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cand = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(cand, dict) and "metric" in cand:
+                    bench = cand   # last JSON line wins (retry runs)
+    if bench is None and isinstance(art.get("parsed"), dict):
+        bench = art["parsed"]
+    if bench is None or n is None:
+        return None
+    return int(n), bench
+
+
+def load_series(paths: list[str]) -> list[dict]:
+    """Parse and order the artifact series by run number."""
+    rows = []
+    for p in paths:
+        parsed = parse_artifact(p)
+        if parsed is None:
+            print(f"bench_report: skipping unparseable {p}", file=sys.stderr)
+            continue
+        n, bench = parsed
+        row = {"n": n, "path": os.path.basename(p)}
+        for key, (fn, _label) in METRICS.items():
+            try:
+                v = fn(bench)
+            except Exception:
+                v = None
+            row[key] = float(v) if isinstance(v, (int, float)) else None
+        rows.append(row)
+    rows.sort(key=lambda r: r["n"])
+    return rows
+
+
+def detect_regressions(rows: list[dict], threshold: float) -> list[str]:
+    """Last-present vs previous-present comparison per metric."""
+    regressions = []
+    for key, (_fn, label) in METRICS.items():
+        present = [(r["n"], r[key]) for r in rows if r[key] is not None]
+        if len(present) < 2:
+            continue
+        (prev_n, prev), (last_n, last) = present[-2], present[-1]
+        if prev <= 0:
+            continue
+        drop = 1.0 - last / prev
+        if drop > threshold:
+            regressions.append(
+                f"{label}: r{last_n:02d} {last:g} is {drop:.1%} below "
+                f"r{prev_n:02d} {prev:g} (threshold {threshold:.0%})")
+    return regressions
+
+
+def format_table(rows: list[dict]) -> str:
+    heads = ["run"] + [label for _k, (_f, label) in METRICS.items()]
+    widths = [max(len(h), 10) for h in heads]
+    out = ["  ".join(h.rjust(w) for h, w in zip(heads, widths))]
+    for r in rows:
+        cells = [f"r{r['n']:02d}"]
+        for key in METRICS:
+            v = r[key]
+            cells.append("-" if v is None else f"{v:g}")
+        out.append("  ".join(c.rjust(w) for c, w in zip(cells, widths)))
+    # per-metric delta line: last vs previous present value
+    deltas = ["delta"]
+    for key in METRICS:
+        present = [r[key] for r in rows if r[key] is not None]
+        if len(present) < 2 or present[-2] == 0:
+            deltas.append("-")
+        else:
+            deltas.append(f"{present[-1] / present[-2] - 1.0:+.1%}")
+    out.append("  ".join(c.rjust(w) for c, w in zip(deltas, widths)))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("artifacts", nargs="*",
+                    help="BENCH_r*.json files (or use --dir)")
+    ap.add_argument("--dir", help="glob BENCH_r*.json under this directory")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative drop that counts as a regression "
+                         "(default 0.10)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="gate mode: one summary line instead of the table "
+                         "(same exit codes)")
+    args = ap.parse_args(argv)
+
+    paths = list(args.artifacts)
+    if args.dir:
+        paths.extend(sorted(glob.glob(os.path.join(args.dir,
+                                                   "BENCH_r*.json"))))
+    # positional args overlapping --dir must not list an artifact twice:
+    # a duplicate pairs a run against itself in the last-vs-previous
+    # comparison and masks a real regression
+    paths = list(dict.fromkeys(os.path.abspath(p) for p in paths))
+    if not paths:
+        print("bench_report: no artifacts given (pass files or --dir)",
+              file=sys.stderr)
+        return 2
+    rows = load_series(paths)
+    if not rows:
+        print("bench_report: no parseable bench artifacts", file=sys.stderr)
+        return 2
+    regressions = detect_regressions(rows, args.threshold)
+    if args.json:
+        print(json.dumps({"trajectory": rows, "regressions": regressions},
+                         indent=2))
+    elif args.check:
+        print(f"bench trajectory: {len(rows)} artifact(s) "
+              f"r{rows[0]['n']:02d}..r{rows[-1]['n']:02d}, "
+              f"{len(regressions)} regression(s)")
+    else:
+        print(format_table(rows))
+    for r in regressions:
+        print(f"REGRESSION: {r}", file=sys.stderr)
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,10 @@ import sys
 #: the ROADMAP tier-1 gate's own progress-line shape — keep identical so
 #: this tool and the gate can never disagree about DOTS
 DOTS_RE = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+#: passed-in-window baseline the ROADMAP gate tracks (PR 4 moved 173 ->
+#: 214 with the persistent compile cache); the report prints the delta so
+#: a budget regression is visible in the same line as the count
+BASELINE_DOTS = 214
 SUMMARY_RE = re.compile(
     r"^=+ .*(passed|failed|error|no tests ran).* =+$"
     r"|^\d+ (passed|failed|error)[^=]*in [0-9.]+m?s.*$")
@@ -69,6 +73,8 @@ def parse_log(text: str) -> dict:
             cache_line = m.group(1)
     return {
         "dots": dots,
+        "dots_baseline": BASELINE_DOTS,
+        "dots_delta": dots - BASELINE_DOTS,
         "progress_lines": progress_lines,
         "summary": summary,
         "failures": failures,
@@ -79,7 +85,8 @@ def parse_log(text: str) -> dict:
 
 def format_report(rep: dict) -> str:
     lines = [f"tier-1 log digest: DOTS={rep['dots']}"
-             f" (over {rep['progress_lines']} progress line(s))"]
+             f" ({rep['dots_delta']:+d} vs the {rep['dots_baseline']} "
+             f"baseline, over {rep['progress_lines']} progress line(s))"]
     if rep["summary"]:
         lines.append(f"summary: {rep['summary']}")
     if rep["compile_cache"]:

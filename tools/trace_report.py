@@ -28,6 +28,9 @@ Report sections:
 - straggler ranking: per-rank mean end-to-end contribution,
 - compile accounting: program builds / first-call (trace+XLA) time per
   program name, LRU hit/miss counters from the registry snapshots,
+- cost attribution (fedcost, ``--cost_attribution`` runs): per program the
+  static GEMM/lane-fill table's ceiling and top ops, plus achieved-FLOP/s
+  (and MFU on TPU) against measured device spans / round walls,
 - device memory: per-rank high-water of the round-boundary sampler lane,
 - wire anomalies: retransmits / gave_up / dup_dropped / chaos counters,
 - overlap_frac per round (host pipeline stage counters, where present).
@@ -51,6 +54,7 @@ from collections import defaultdict
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
+from fedml_tpu.obs.cost import roofline as cost_roofline  # noqa: E402
 from fedml_tpu.obs.export import read_jsonl, write_chrome_trace  # noqa: E402
 
 #: event kinds that constitute a span graph; a file with none of these
@@ -104,6 +108,7 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
     compile_spans: dict[str, dict] = {}   # program name -> {count, ms}
     device_mem: dict[object, dict] = {}   # rank -> series -> high-water
     device_mem_samples = 0
+    cost_programs: dict[str, dict] = {}   # fedcost program_cost instants
 
     for ev in events:
         ph, name = ev.get("ph"), ev.get("name")
@@ -160,6 +165,11 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
                 retransmits.append(ev)
             elif name == "chaos_drop":
                 chaos_drops += 1
+            elif name == "program_cost" and ev.get("cat") == "cost":
+                a = _args(ev)
+                if a.get("program"):
+                    # re-attributions (new shape key) keep the LAST record
+                    cost_programs[a["program"]] = a
         elif ph == "C":
             if name == "registry":
                 # each flush writes a full CUMULATIVE registry snapshot, so
@@ -314,6 +324,65 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
             "spans": {k: {"count": v["count"], "ms": round(v["ms"], 3)}
                       for k, v in sorted(compile_spans.items())},
         }
+    if cost_programs:
+        # achieved-FLOP/s per program: static GEMM FLOPs per invocation
+        # against the MEASURED duration — fedscope device spans for mesh
+        # programs (matched by path; amortized super-step rounds excluded:
+        # their per-round split is synthetic), the round wall for a sim
+        # program when it is unambiguous (exactly one sim program, no
+        # device lanes to confuse it with).
+        path_ms: dict[str, list] = {}
+        for _r, per in device_rows.items():
+            # one entry per ROUND per path, slowest rank/host wins — summing
+            # over ranks would double-count the same device step in a merged
+            # multi-host trace (same critical-path convention as above)
+            per_path: dict[str, float] = {}
+            for row in per.values():
+                if row.get("path") and not row.get("amortized"):
+                    p = row["path"]
+                    per_path[p] = max(per_path.get(p, 0.0), row["device_ms"])
+            for p, ms in per_path.items():
+                path_ms.setdefault(p, []).append(ms)
+        sim_progs = [p for p, a in cost_programs.items() if not a.get("path")]
+        achieved: dict[str, dict] = {}
+        for pname, a in cost_programs.items():
+            s = a.get("summary") or {}
+            flops = s.get("gemm_flops_per_invocation") or 0.0
+            entry = None
+            if a.get("path") and path_ms.get(a["path"]):
+                ms = path_ms[a["path"]]
+                entry = {"rounds": len(ms),
+                         "measured_ms": round(sum(ms), 3),
+                         "basis": "device spans"}
+            elif (not a.get("path") and len(sim_progs) == 1
+                  and timeline and not device_rows):
+                walls = [e["wall_ms"] for e in timeline]
+                entry = {"rounds": len(walls),
+                         "measured_ms": round(sum(walls), 3),
+                         "basis": "round wall (host+device)"}
+            if entry and flops and entry["measured_ms"] > 0:
+                # ONE achieved-FLOP/s / MFU convention (obs/cost.roofline):
+                # reimplementing the division here is exactly the drift the
+                # shared module exists to prevent
+                rf = cost_roofline(s, entry["measured_ms"] / 1e3,
+                                   invocations=entry["rounds"],
+                                   peak=a.get("peak_bf16_flops"))
+                entry["achieved_gflops_per_sec"] = \
+                    rf["achieved_gflops_per_sec"]
+                if rf["mfu_mac"] is not None:
+                    entry["mfu_mac"] = rf["mfu_mac"]
+                    if "mfu_vs_ceiling" in rf:
+                        entry["mfu_vs_ceiling"] = rf["mfu_vs_ceiling"]
+                achieved[pname] = entry
+        rep["cost"] = {
+            "programs": {
+                p: {"shape_key": a.get("shape_key"), "path": a.get("path"),
+                    "summary": a.get("summary"),
+                    "xla_cost": a.get("xla_cost"),
+                    "peak_table_entry": a.get("peak_table_entry")}
+                for p, a in sorted(cost_programs.items())},
+            "achieved": achieved,
+        }
     if supersteps:
         rep["supersteps"] = supersteps
     if device_mem:
@@ -390,6 +459,39 @@ def format_report(rep: dict) -> str:
             lines.append(f"  rank {s['rank']!s:>6}  "
                          f"{s['mean_chain_ms']:>9.1f} ms"
                          f"  over {s['rounds']} round(s)")
+    costsec = rep.get("cost")
+    if costsec:
+        lines.append("")
+        lines.append("cost attribution (fedcost, static per-op roofline):")
+        for pname, p in costsec["programs"].items():
+            s = p.get("summary") or {}
+            ceil = s.get("out_lane_ceiling")
+            head = (f"  {pname}: "
+                    f"{(s.get('gemm_flops_per_invocation') or 0) / 1e9:.3f} "
+                    f"GFLOP/invocation over {s.get('gemm_ops', 0)} GEMM "
+                    f"op(s)")
+            if ceil is not None:
+                head += f", out-lane ceiling {ceil * 100:.1f}%"
+            if s.get("unknown_trip_counts"):
+                head += " [trip count unknown for some loops]"
+            lines.append(head)
+            for o in (s.get("top_ops") or [])[:3]:
+                lines.append(
+                    f"      {o['kind']} x{o['count']}  "
+                    f"M={o['m']} K={o['k']} N={o['n']}"
+                    + (f" g={o['groups']}" if o.get("groups", 1) > 1 else "")
+                    + f"  fill {o['out_lane_fill'] * 100:.1f}%"
+                    f"  {o['flops'] * o['count'] / 1e9:.3f} GFLOP")
+            ach = costsec["achieved"].get(pname)
+            if ach:
+                row = (f"      achieved: "
+                       f"{ach['achieved_gflops_per_sec']:.2f} GFLOP/s over "
+                       f"{ach['rounds']} round(s) [{ach['basis']}]")
+                if ach.get("mfu_mac") is not None:
+                    row += (f", mfu {ach['mfu_mac'] * 100:.2f}% = "
+                            f"{ach.get('mfu_vs_ceiling', 0) * 100:.0f}% of "
+                            f"the lane ceiling")
+                lines.append(row)
     comp = rep.get("compile")
     if comp and (comp["counters"] or comp["spans"]):
         c = comp["counters"]
