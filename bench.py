@@ -190,6 +190,10 @@ def _bench_crossdevice(tiny: bool):
                       batch_size=10)
     bundle = create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:])
 
+    from fedml_tpu.obs import pulse_if_enabled
+
+    plane = pulse_if_enabled()
+
     def measure(pipeline_depth: int):
         cfg = FedConfig(
             model="lr", dataset="stackoverflow_lr",
@@ -216,6 +220,12 @@ def _bench_crossdevice(tiny: bool):
             # window's FIRST round pays a cold on-demand build and a
             # 3-round measurement understates the pipeline by ~1/3
             pf.prime(1, wait=True)
+        # fresh per-client profiles for the MEASURED window only: the warm
+        # rounds above (and the other A/B arm's identical cohorts) would
+        # otherwise double participation counts and seed EMA train-ms with
+        # compile-dominated warmup walls
+        if plane is not None and plane.profiler is not None:
+            plane.profiler.reset()
         t0 = time.perf_counter()
         for r in range(1, rounds + 1):
             last = api.run_round(r)
@@ -237,6 +247,10 @@ def _bench_crossdevice(tiny: bool):
     off = measure(0)
     on = measure(depth) if depth > 0 else None
     head = on or off
+    # fedpulse profiler aggregates of the HEAD arm (the last measured):
+    # per-client EMA train-ms spread, participation fairness, store bytes —
+    # the live-telemetry evidence at the 342k-client operating point
+    profiler_agg = plane.aggregates() if plane is not None else None
     return {
         "paradigm": "cross-device sampled materialization (virtual client "
                     "stack, O(cohort) memory, host round pipeline)",
@@ -247,6 +261,7 @@ def _bench_crossdevice(tiny: bool):
         "examples_per_sec": head["examples_per_sec"],
         "materialized_rows": head["materialized_rows"],
         "device_resident": False,
+        "profiler": profiler_agg,
         "pipeline_ab": {
             "off": off, "on": on, "depth": depth,
             "speedup": (round(on["rounds_per_sec"] / off["rounds_per_sec"], 3)
@@ -282,6 +297,17 @@ def main():
     if not os.environ.get("BENCH_NO_ROOFLINE"):
         fedcost.reset_cost_tables()   # this run's programs only
         fedcost.enable_cost_attribution(True)
+
+    # fedpulse: a profiler-only plane (no pulse stream unless
+    # BENCH_PULSE_PATH names one) so the tail carries end-of-run per-client
+    # aggregates — participation fairness and EMA train-ms spread become
+    # part of the TPU-host trajectory. BENCH_NO_PULSE=1 opts out.
+    from fedml_tpu.obs import live as fedpulse
+
+    pulse_plane = None
+    if not os.environ.get("BENCH_NO_PULSE"):
+        pulse_plane = fedpulse.configure(
+            os.environ.get("BENCH_PULSE_PATH"), profile_store=True)
 
     # BENCH_SCALE=tiny: CI/CPU smoke of the same code path (not a benchmark).
     tiny = os.environ.get("BENCH_SCALE") == "tiny"
@@ -334,6 +360,10 @@ def main():
         last = api.run_round(r)
     float(last)
 
+    # profile the MEASURED pass only: the warmup pass above already fed the
+    # same cohorts (participation would double, EMA would blend compiles)
+    if pulse_plane is not None and pulse_plane.profiler is not None:
+        pulse_plane.profiler.reset()
     t0 = time.perf_counter()
     for r in range(1, rounds + 1):
         last = api.run_round(r)
@@ -372,6 +402,13 @@ def main():
     # under the same names (tables were reset at attribution enable, so
     # everything recorded so far is the flagship's)
     flagship_tables = fedcost.cost_tables()
+    # flagship profiler snapshot for the same reason: the paradigm benches
+    # reuse client ids 0..31, which would merge into the flagship's profiles
+    flagship_profiler = None
+    if pulse_plane is not None:
+        flagship_profiler = pulse_plane.aggregates()
+        if pulse_plane.profiler is not None:
+            pulse_plane.profiler.reset()
 
     # Cross-silo paradigm on the same hardware (VERDICT r2 #3): the north
     # star names DISTRIBUTED FedAvg, so measure the shard_map mesh path too —
@@ -516,6 +553,9 @@ def main():
         # model's GEMM shapes allow (1.0 = lanes are the only limit) —
         # both sides of the division count GEMM multiply-accumulates only
         "mfu_vs_lane_ceiling": mfu_vs_lane_ceiling,
+        # fedpulse end-of-run profiler aggregates for the flagship pass
+        # (the cross-device block embeds its own at 342k-client scale)
+        "profiler": flagship_profiler,
         "roofline": roofline,
         "registry": registry_snapshot,
         "device": str(jax.devices()[0]),

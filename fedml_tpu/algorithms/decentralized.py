@@ -95,6 +95,11 @@ class DecentralizedFedAPI(FedAvgAPI):
 
         return round_step
 
+    def _pulse_cohort(self, round_idx: int):
+        # gossip rounds train EVERY node, ignoring client sampling — the
+        # base implementation would profile a phantom sampled cohort
+        return np.arange(self.dataset.num_clients, dtype=np.int64)
+
     def _run_round_inner(self, round_idx: int) -> float:
         # the traced-span wrapper is the inherited run_round (fedavg.py);
         # overriding the INNER hook keeps gossip rounds on the one timeline

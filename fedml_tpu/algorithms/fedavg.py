@@ -101,6 +101,15 @@ class FedAvgAPI:
         self._gather_steps: dict[int, Callable] = {}
         self._group_steps: dict[tuple, Callable] = {}
         self._packed_steps: dict[tuple, Callable] = {}
+        # recently computed round plans (round_idx -> (sampled, live)) —
+        # stashed by _run_round_inner AND the prefetcher's background
+        # builds so the fedpulse wrapper can reuse the plan the round
+        # ALREADY computed instead of re-paying the O(client_num_in_total)
+        # sampling draw per round (the same cost _host_round_inputs'
+        # plan= parameter exists to avoid). Dict (not a single slot)
+        # because pipelined builds of several FUTURE rounds race the
+        # consuming round; bounded, entries popped on use.
+        self._plan_stash: dict = {}
         # host round pipeline (data/pipeline.CohortPrefetcher): lazy — built
         # by the first host-path round when config.host_pipeline_depth > 0
         self._prefetcher = None
@@ -608,8 +617,14 @@ class FedAvgAPI:
         from fedml_tpu.data.pipeline import materialize_cohort
         from fedml_tpu.utils.dtypes import host_bf16_cast
 
-        sampled, live, bucket = plan if plan is not None \
-            else self._round_plan(round_idx)
+        if plan is not None:
+            sampled, live, bucket = plan
+        else:
+            # prefetcher path: this build's plan is the one the consuming
+            # round's pulse hook will want — stash it so pulse-on pipelined
+            # runs don't re-pay the sampling draw on the critical path
+            sampled, live, bucket = self._round_plan(round_idx)
+            self._stash_plan(round_idx, sampled, live)
         cx, cy, cm, counts = materialize_cohort(
             self.dataset, sampled, pool, n_chunks)
         if bucket is not None:
@@ -740,22 +755,67 @@ class FedAvgAPI:
         THE traced wrapper: every paradigm's round logic lives in
         ``_run_round_inner`` (subclasses override THAT, never this — the
         fedlint ``trace-coverage`` rule enforces it), so one span per round
-        plus the round-boundary device-memory sample cover the whole zoo."""
-        from fedml_tpu.obs import sample_device_memory, tracer_if_enabled
+        plus the round-boundary device-memory sample cover the whole zoo.
+        The fedpulse plane rides the same wrapper: with ``--pulse_path``
+        set, every round feeds the per-client profiler and appends one
+        snapshot to the pulse stream — both gates are one global read when
+        off, and neither touches the round's math."""
+        from fedml_tpu.obs import (pulse_if_enabled, sample_device_memory,
+                                   tracer_if_enabled)
 
         tr = tracer_if_enabled(0)
-        if tr is None:
+        pulse = pulse_if_enabled()
+        if tr is None and pulse is None:
             return self._run_round_inner(round_idx)
-        with tr.span("round", cat="round", args={"round": round_idx}):
+        t0 = time.perf_counter()
+        if tr is None:
             out = self._run_round_inner(round_idx)
-        if getattr(self.config, "trace_device_sampler", True):
-            sample_device_memory(tr, round_idx)
+        else:
+            with tr.span("round", cat="round", args={"round": round_idx}):
+                out = self._run_round_inner(round_idx)
+            if getattr(self.config, "trace_device_sampler", True):
+                sample_device_memory(tr, round_idx)
+        if pulse is not None:
+            # with async_rounds `out` is an un-synced device scalar and the
+            # wall measured dispatch; the plane never float()s it (that
+            # would force the sync the flag exists to avoid)
+            pulse.on_sim_round(self, round_idx,
+                               out, (time.perf_counter() - t0) * 1e3)
         return out
+
+    def _stash_plan(self, round_idx: int, sampled, live) -> None:
+        """Record a computed round plan for :meth:`_pulse_cohort` (single
+        dict store under the GIL — the prefetcher's background builds and
+        the main thread may both write, always to distinct round keys)."""
+        stash = self._plan_stash
+        stash[int(round_idx)] = (sampled, live)
+        while len(stash) > 16:   # bound: pipeline depth + slack
+            stash.pop(next(iter(stash)))
+
+    def _pulse_cohort(self, round_idx: int) -> Optional[np.ndarray]:
+        """Logical client ids this round actually TRAINED, for the fedpulse
+        profiler. Default: the round plan's live cohort, reusing the plan
+        the round (or its background prefetch build) already stashed —
+        the fallback re-derivation is deterministic but re-pays the
+        O(client_num_in_total) sampling draw. Paradigms whose rounds
+        train a different population than the sampled cohort (the
+        decentralized gossip family trains EVERY node) override this —
+        otherwise the pulse stream would profile a phantom cohort."""
+        plan = self._plan_stash.pop(int(round_idx), None)
+        if plan is not None:
+            sampled, live = plan
+        else:
+            sampled, live, _bucket = self._round_plan(round_idx)
+        ids = np.asarray(sampled, np.int64)
+        if live is not None:
+            ids = ids[np.asarray(live) > 0]
+        return ids
 
     def _run_round_inner(self, round_idx: int) -> "float | jax.Array":
         rk = round_key(self.root_key, round_idx)
         if self._dev_train is not None:
             sampled, live, bucket = self._round_plan(round_idx, record=True)
+            self._stash_plan(round_idx, sampled, live)
             live_np = (np.ones((len(sampled),), np.float32) if live is None
                        else np.asarray(live, np.float32))
             if self.config.pack_lanes > 0:
@@ -806,6 +866,7 @@ class FedAvgAPI:
             else:
                 t0 = time.perf_counter()
                 sampled, live, bucket = self._round_plan(round_idx, record=True)
+                self._stash_plan(round_idx, sampled, live)
                 cx, cy, cm, counts = self._host_round_inputs(
                     round_idx, plan=(sampled, live, bucket))
                 mat_ms = (time.perf_counter() - t0) * 1e3

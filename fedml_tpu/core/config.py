@@ -226,6 +226,29 @@ class FedConfig:
     # Pure static analysis: one extra trace per program build (no compile,
     # no device sync), numerics bit-identical on or off.
     cost_attribution: bool = False
+    # fedpulse live telemetry plane (obs/live + obs/profile, DESIGN.md §14):
+    # when set, every round boundary appends ONE atomic JSON snapshot
+    # (registry time/wire/chaos/compile lanes, host-stage row, per-client
+    # profiler aggregates, cost-attribution MFU, health verdict) to this
+    # file — tail it live with tools/fedtop.py. None (default) disables the
+    # whole plane: the hot path sees one global read and allocates nothing,
+    # and a pulse-on run is bit-identical to a pulse-off run (the plane
+    # only reads counters and clocks).
+    pulse_path: Optional[str] = None
+    # optional Prometheus textfile-collector mirror: each snapshot also
+    # atomically rewrites <dir>/fedpulse.prom as flat gauges (requires
+    # pulse_path)
+    pulse_prometheus_dir: Optional[str] = None
+    # fedpulse health watchdog (obs/health): rules evaluated at every round
+    # boundary while the plane is on. NaN-loss and wire gave_up are always
+    # armed; the knobs below arm/tune the rest (0/None = that rule off).
+    health_loss_limit: float = 0.0        # loss > limit -> divergent_loss
+    health_stall_sec: Optional[float] = None  # round wall > this -> stall
+    health_stale_spike: int = 8           # stale_uploads delta/round -> warn
+    health_skew: float = 4.0              # p95/p50 EMA train-ms -> warn
+    # escalate-to-raise: any critical health event raises
+    # FederationHealthError AFTER its pulse snapshot is written
+    health_escalate: bool = False
     # fedscope device-memory sampler: when tracing is on, snapshot
     # jax.local_devices() memory_stats (bytes_in_use + peak watermark) at
     # every round boundary into a "device" counter lane (one allocator read
@@ -281,6 +304,23 @@ class FedConfig:
         if self.trace_buffer_events < 1:
             raise ValueError(
                 f"trace_buffer_events must be >= 1, got {self.trace_buffer_events}")
+        if self.pulse_prometheus_dir and not self.pulse_path:
+            raise ValueError(
+                "pulse_prometheus_dir requires pulse_path: the Prometheus "
+                "mirror re-renders the pulse snapshots, which only exist "
+                "when the pulse stream is on")
+        if self.health_loss_limit < 0:
+            raise ValueError(
+                f"health_loss_limit must be >= 0, got {self.health_loss_limit}")
+        if self.health_stall_sec is not None and self.health_stall_sec <= 0:
+            raise ValueError(
+                f"health_stall_sec must be > 0, got {self.health_stall_sec}")
+        if self.health_stale_spike < 0:
+            raise ValueError(
+                f"health_stale_spike must be >= 0, got {self.health_stale_spike}")
+        if self.health_skew < 0:
+            raise ValueError(
+                f"health_skew must be >= 0, got {self.health_skew}")
         if self.checkpoint_frequency < 1:
             raise ValueError(
                 f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"
@@ -462,6 +502,31 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--trace_buffer_events", type=int,
                    default=defaults.trace_buffer_events,
                    help="per-rank trace ring-buffer bound (events)")
+    p.add_argument("--pulse_path", type=str, default=None,
+                   help="fedpulse live telemetry: append one atomic JSON "
+                        "snapshot per round boundary to this file; tail it "
+                        "with tools/fedtop.py (None = plane off)")
+    p.add_argument("--pulse_prometheus_dir", type=str, default=None,
+                   help="also mirror each pulse snapshot as Prometheus "
+                        "textfile gauges (<dir>/fedpulse.prom)")
+    p.add_argument("--health_loss_limit", type=float,
+                   default=defaults.health_loss_limit,
+                   help="watchdog: loss above this is divergent_loss "
+                        "(0 = rule off; NaN loss is always critical)")
+    p.add_argument("--health_stall_sec", type=float, default=None,
+                   help="watchdog: a round wall beyond this many seconds "
+                        "is a round_stall (None = rule off)")
+    p.add_argument("--health_stale_spike", type=int,
+                   default=defaults.health_stale_spike,
+                   help="watchdog: stale_uploads growth per round that "
+                        "counts as a spike (0 = rule off)")
+    p.add_argument("--health_skew", type=float, default=defaults.health_skew,
+                   help="watchdog: p95/p50 EMA train-ms ratio flagged as "
+                        "straggler skew (0 = rule off)")
+    p.add_argument("--health_escalate", type=lambda s: bool(int(s)),
+                   default=defaults.health_escalate,
+                   help="raise FederationHealthError on critical health "
+                        "events (0|1; snapshot is written first)")
     p.add_argument("--trace_device_sampler", type=lambda s: bool(int(s)),
                    default=defaults.trace_device_sampler,
                    help="sample per-device memory at round boundaries into "

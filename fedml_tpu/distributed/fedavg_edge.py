@@ -16,6 +16,7 @@ re-binding, exactly like the reference's client-sampling concurrency model
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 import jax
@@ -200,6 +201,9 @@ class FedAvgEdgeServerManager(ServerManager):
         # at _MAX_EMPTY_DEADLINES the federation tears down instead of
         # waiting forever for a rejoin that may never come
         self._empty_deadlines = 0
+        # fedpulse round clock: broadcast -> aggregate wall, and the base
+        # each accepted upload's arrival latency is measured against
+        self._round_t0 = time.perf_counter()
 
     _MAX_EMPTY_DEADLINES = MAX_EMPTY_DEADLINES
 
@@ -323,6 +327,9 @@ class FedAvgEdgeServerManager(ServerManager):
             # measures the LAST attempt, and the earlier one is dropped.
             tr.begin_span(("round", self.round_idx), "round", cat="round",
                           args={"round": self.round_idx, "role": "server"})
+        # fedpulse round clock restarts at (re)broadcast — same last-attempt
+        # semantics as the keyed span above
+        self._round_t0 = time.perf_counter()
         override = self._downlink_codec()
         effective = override if override is not None else getattr(
             self.aggregator.config, "wire_codec", "raw")
@@ -447,6 +454,13 @@ class FedAvgEdgeServerManager(ServerManager):
                 self.stale_uploads += 1
                 return   # pre-re-deal upload of the current round
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        # what actually rode the wire: the sparse/small delta for delta
+        # uploads, the full weights otherwise — the reconstructed tree
+        # below would overstate a delta upload's bytes by the full-model
+        # ratio in exactly the bandwidth-constrained deployments the
+        # profiler's upload accounting is for
+        wire_tree = (payload if payload is not None
+                     else msg.get(MSG_ARG_KEY_MODEL_DELTA))
         if payload is None:
             # delta upload: reconstruct the worker model against the image
             # of the downlink the workers trained from this round, cached
@@ -463,6 +477,23 @@ class FedAvgEdgeServerManager(ServerManager):
         self.aggregator.add_local_trained_result(
             sender - 1, payload, msg.get(MSG_ARG_KEY_NUM_SAMPLES)
         )
+        from fedml_tpu.obs import pulse_if_enabled
+
+        pulse = pulse_if_enabled()
+        if pulse is not None:
+            # the broadcast->upload latency the server OBSERVED for this
+            # worker (wire down + train + wire up — the signal the straggler
+            # deadline acts on), attributed to its assigned logical clients;
+            # bytes are the DECODED size of the tree the wire carried (delta
+            # for delta uploads) — no re-serialization, so a lossy codec's
+            # further ratio (q8/topk) is not modeled here
+            pulse.observe_upload(
+                self._assignment_map.get(sender - 1) or [],
+                self.round_idx,
+                train_ms=(time.perf_counter() - self._round_t0) * 1e3,
+                upload_bytes=float(sum(
+                    getattr(leaf, "nbytes", 8)
+                    for leaf in jax.tree.leaves(wire_tree))))
         if self._deadline is not None:
             if not self._expected <= set(self.aggregator.model_dict.keys()):
                 return
@@ -471,26 +502,45 @@ class FedAvgEdgeServerManager(ServerManager):
         self._complete_round()
 
     def _complete_round(self):
-        from fedml_tpu.obs import tracer_if_enabled
+        from fedml_tpu.obs import pulse_if_enabled, tracer_if_enabled
 
         self._cancel_timer()
+        uploads = len(self.aggregator.model_dict)
         tr = tracer_if_enabled(self.rank)
         if tr is None:
             global_params = self.aggregator.aggregate()
         else:
             with tr.span("aggregate", cat="round",
                          args={"round": self.round_idx,
-                               "uploads": len(self.aggregator.model_dict)}):
+                               "uploads": uploads}):
                 global_params = self.aggregator.aggregate()
             tr.end_span(("round", self.round_idx))
         if self._deadline is not None:
             for i in self.aggregator.flag_client_model_uploaded_dict:
                 self.aggregator.flag_client_model_uploaded_dict[i] = False
+        metrics = None
         if (
             self.round_idx % self.args.frequency_of_the_test == 0
             or self.round_idx == self.round_num - 1
         ):
-            self.aggregator.test_on_server_for_all_clients(self.round_idx)
+            metrics = self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        pulse = pulse_if_enabled()
+        if pulse is not None:
+            # one pulse snapshot per completed round, from the server (the
+            # only rank that sees the whole broadcast->aggregate path); its
+            # stale-upload/liveness counters ride the wire lane so the
+            # watchdog's spike rules see them. May raise (escalate mode) —
+            # AFTER the snapshot is written, and the round is already
+            # aggregated, so the stream records the dying state.
+            pulse.on_round(
+                self.round_idx, source="edge_server",
+                loss=(float(metrics["loss"]) if metrics
+                      and metrics.get("loss") is not None else None),
+                round_ms=(time.perf_counter() - self._round_t0) * 1e3,
+                extra={"stale_uploads": self.stale_uploads,
+                       "uploads": uploads,
+                       "workers_alive": sum(
+                           1 for a in self._alive.values() if a)})
         self.round_idx += 1
         self._maybe_checkpoint()
         if self.round_idx >= self.round_num:

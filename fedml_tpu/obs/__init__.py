@@ -28,10 +28,21 @@ assumes (arXiv:2303.01778):
   lowered to HLO and read back as a GEMM table (M/K/N, FLOPs, MXU lane
   fills) with a flop-weighted lane ceiling per program; also the single
   shared peak-FLOPs table behind every MFU number.
+- :mod:`fedml_tpu.obs.profile` / :mod:`fedml_tpu.obs.live` /
+  :mod:`fedml_tpu.obs.health` (fedpulse) — the LIVE plane: a bounded
+  array-backed per-client profile store (EMA train-ms, upload bytes,
+  participation, staleness — the signals cohort scheduling and FedBuff
+  weighting consume), a ``pulse.jsonl`` streaming exporter of atomic
+  round-boundary snapshots (registry lanes, profiler aggregates, cost
+  MFU) with an optional Prometheus textfile mirror, and a rule-driven
+  health watchdog (NaN/divergent loss, round stall, ``gave_up``/
+  ``stale_uploads`` spikes, straggler skew) with an escalate-to-raise
+  mode. ``tools/fedtop.py`` tails the stream live.
 
 Tracing is OFF by default and enabled per run via ``--trace_dir``
-(core/config.py). The contract: a traced run is bit-identical to an
-untraced run — the tracer only ever reads clocks.
+(core/config.py); the pulse plane likewise via ``--pulse_path``. The
+contract: a traced or pulsed run is bit-identical to a plain run — these
+modules only ever read clocks and counters.
 """
 
 from fedml_tpu.obs.compile import compile_counters, record_cache_hit, timed_build
@@ -44,6 +55,14 @@ from fedml_tpu.obs.cost import (
     reset_cost_tables,
 )
 from fedml_tpu.obs.device import sample_device_memory
+from fedml_tpu.obs.health import FederationHealthError, HealthWatchdog
+from fedml_tpu.obs.live import (
+    LiveExporter,
+    PulsePlane,
+    pulse_enabled,
+    pulse_if_enabled,
+)
+from fedml_tpu.obs.profile import ClientProfiler
 from fedml_tpu.obs.registry import (
     CounterGroup,
     MetricsRegistry,
@@ -63,8 +82,13 @@ from fedml_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "ClientProfiler",
     "CounterGroup",
+    "FederationHealthError",
+    "HealthWatchdog",
+    "LiveExporter",
     "MetricsRegistry",
+    "PulsePlane",
     "Tracer",
     "compile_counters",
     "configure",
@@ -78,6 +102,8 @@ __all__ = [
     "reset_cost_tables",
     "flush_all",
     "get_tracer",
+    "pulse_enabled",
+    "pulse_if_enabled",
     "record_cache_hit",
     "reset",
     "sample_device_memory",

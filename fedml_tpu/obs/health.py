@@ -1,0 +1,149 @@
+"""Federation health watchdog: rule-driven round-boundary checks.
+
+The trace stack answers "where did the time go" after the run; nothing in
+the tree answers "is this federation healthy RIGHT NOW" while it serves —
+the question a long-lived multi-tenant gateway (ROADMAP item 4) and any
+unattended cross-device run needs. :class:`HealthWatchdog` closes that gap
+with a fixed rule set evaluated at every round boundary, over signals the
+round already produces (no extra syncs, no device reads):
+
+==================  ========  =============================================
+rule                severity  fires when
+==================  ========  =============================================
+``nan_loss``        critical  the round loss is NaN/inf (always armed)
+``divergent_loss``  critical  loss exceeds ``--health_loss_limit`` (>0)
+``round_stall``     critical  the round wall exceeds ``--health_stall_sec``
+``gave_up``         critical  the wire ``gave_up`` counter moved this round
+                              (a message was abandoned after retry
+                              exhaustion — data loss, always armed)
+``stale_spike``     warn      ``stale_uploads`` grew by at least
+                              ``--health_stale_spike`` this round (late
+                              retransmits of deadline-closed rounds piling
+                              up — the chaos/straggler signature)
+``straggler_skew``  warn      profiler p95/p50 EMA train-ms exceeds
+                              ``--health_skew`` over >= 4 seen clients
+==================  ========  =============================================
+
+Counter rules are DELTA rules: the watchdog tracks the previous round's
+cumulative counters, so a historical anomaly doesn't re-fire forever.
+Events append to the pulse stream and (under tracing) become ``health``
+trace instants; ``state`` is the worst severity ever seen (sticky), which
+is what fedtop's header shows. With ``--health_escalate 1``
+:meth:`maybe_escalate` raises :class:`FederationHealthError` on any
+critical event — AFTER the round's pulse snapshot is written, so the
+stream records what killed the run. Evaluation only reads numbers the
+round already computed: a watched run is bit-identical to an unwatched
+one.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Optional
+
+_SEVERITY = {"ok": 0, "warn": 1, "critical": 2}
+_STATES = {v: k for k, v in _SEVERITY.items()}
+
+
+class FederationHealthError(RuntimeError):
+    """Raised by escalate mode on a critical health event; carries the
+    triggering events so the driver can log/act on them."""
+
+    def __init__(self, events: list):
+        self.events = list(events)
+        rules = ", ".join(sorted({e["rule"] for e in self.events}))
+        super().__init__(
+            f"federation health critical ({rules}); first event: "
+            f"{self.events[0]['detail']}")
+
+
+class HealthWatchdog:
+    """Round-boundary health rules (module docstring)."""
+
+    def __init__(self, *, loss_limit: float = 0.0,
+                 stall_sec: Optional[float] = None, stale_spike: int = 8,
+                 skew: float = 4.0, escalate: bool = False,
+                 history: int = 256):
+        self.loss_limit = float(loss_limit or 0.0)
+        self.stall_sec = None if not stall_sec else float(stall_sec)
+        self.stale_spike = int(stale_spike or 0)
+        self.skew = float(skew or 0.0)
+        self.escalate = bool(escalate)
+        #: worst severity ever observed (sticky; fedtop's header state)
+        self.state = "ok"
+        #: bounded event history (a weeks-long run keeps the latest N)
+        self.events: deque = deque(maxlen=int(history))
+        self._prev_wire: dict = {}
+
+    def baseline(self, wire: Optional[dict]) -> None:
+        """Seed the delta rules with pre-existing cumulative counters.
+
+        The registry is process-wide: a second federation in one process
+        inherits the first one's wire totals, and without a baseline the
+        new watchdog would re-fire on round 0 for anomalies that belong to
+        a finished run. ``live.configure`` calls this with the registry's
+        current wire snapshot."""
+        for k, v in (wire or {}).items():
+            if isinstance(v, (int, float)):
+                self._prev_wire[k] = int(v)
+
+    def check_round(self, round_idx: int, *, loss: Optional[float] = None,
+                    round_ms: Optional[float] = None,
+                    wire: Optional[dict] = None,
+                    profile: Optional[dict] = None) -> list:
+        """Evaluate every rule against one round's signals; returns the
+        events that fired (possibly empty). Never raises — escalation is
+        the caller's explicit :meth:`maybe_escalate` step, after the
+        snapshot carrying these events has been persisted."""
+        events: list = []
+
+        def add(rule: str, severity: str, detail: str) -> None:
+            events.append({"round": int(round_idx), "rule": rule,
+                           "severity": severity, "detail": detail})
+
+        if loss is not None:
+            if not math.isfinite(loss):
+                add("nan_loss", "critical", f"round loss is {loss!r}")
+            elif self.loss_limit > 0.0 and loss > self.loss_limit:
+                add("divergent_loss", "critical",
+                    f"loss {loss:.6g} exceeds health_loss_limit "
+                    f"{self.loss_limit:g}")
+        if (self.stall_sec is not None and round_ms is not None
+                and round_ms > self.stall_sec * 1e3):
+            add("round_stall", "critical",
+                f"round took {round_ms:.0f} ms > health_stall_sec "
+                f"{self.stall_sec:g}s")
+        for key, rule, thresh, severity in (
+                ("gave_up", "gave_up", 1, "critical"),
+                ("stale_uploads", "stale_spike", self.stale_spike, "warn")):
+            if thresh <= 0:
+                continue
+            cur = int((wire or {}).get(key, 0) or 0)
+            delta = cur - self._prev_wire.get(key, 0)
+            self._prev_wire[key] = cur
+            if delta >= thresh:
+                add(rule, severity, f"{key} +{delta} this round (total {cur})")
+        if self.skew > 0.0 and profile:
+            ema = profile.get("ema_train_ms") or {}
+            p50, p95 = ema.get("p50"), ema.get("p95")
+            if (p50 and p95 and profile.get("clients_seen", 0) >= 4
+                    and p95 / p50 > self.skew):
+                add("straggler_skew", "warn",
+                    f"p95/p50 EMA train-ms {p95 / p50:.2f} exceeds "
+                    f"health_skew {self.skew:g}")
+        for ev in events:
+            self.events.append(ev)
+        worst = max((_SEVERITY[e["severity"]] for e in events),
+                    default=_SEVERITY["ok"])
+        self.state = _STATES[max(worst, _SEVERITY[self.state])]
+        return events
+
+    def maybe_escalate(self, events: list) -> None:
+        """Escalate-to-raise mode: die loudly on this round's critical
+        events (no-op when escalation is off or nothing critical fired)."""
+        if not self.escalate:
+            return
+        critical = [e for e in events if e["severity"] == "critical"]
+        if critical:
+            raise FederationHealthError(critical)

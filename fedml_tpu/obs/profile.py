@@ -1,0 +1,238 @@
+"""Per-client profile store: the fedpulse memory of who trained how fast.
+
+Every remaining ROADMAP item keys on *per-client* signals the post-hoc
+trace stack cannot serve live: heterogeneity-aware cohort scheduling wants
+observed client speed (FedML Parrot, arXiv:2303.01778), FedBuff-style
+buffered aggregation wants per-client staleness, and the participation-
+fairness question ("which clients never get sampled?") needs counts at the
+342k-client cross-device scale. :class:`ClientProfiler` is that store:
+
+- **array-backed, bounded**: one flat numpy array per field, indexed by
+  logical client id — no per-client Python objects, no dicts. 20 bytes per
+  client slot (EMA train-ms f32, cumulative upload bytes f64, participation
+  i32, last-seen round i32), grown geometrically to the highest observed id
+  and hard-capped at ``max_clients`` (ids beyond the cap are counted in
+  ``dropped``, never silently indexed). 342,477 clients ≈ 7 MB; the store
+  can never balloon past ``max_clients * 20`` bytes, and ``nbytes`` reports
+  the measured footprint so tests pin the bound instead of trusting it.
+- **paradigm-agnostic feed**: the simulation paradigms feed it from the
+  traced ``FedAvgAPI.run_round`` wrapper (cohort ids from the round plan,
+  round wall amortized per client — clients train fused under one vmap, so
+  per-client wall does not exist there); the edge server feeds it per
+  upload on the broadcast→aggregate path (arrival latency + payload bytes,
+  attributed to the worker's assigned logical clients — the same observed-
+  speed signal the straggler deadline acts on).
+- **query surface for the consumers to come**: :meth:`speed_rank` (cohort
+  scheduling), :meth:`staleness` (FedBuff weighting),
+  :meth:`participation_fairness` (sampling audits), and :meth:`aggregates`
+  (the compact round-boundary summary the pulse stream and fedtop render).
+
+Thread-safe (the edge server's handler thread and the sim loop may share
+one process-wide profiler); EMA uses a fixed ``ema_alpha`` so a client's
+speed estimate tracks drift without unbounded history.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+#: bytes per client slot across the four field arrays (f32 + f64 + 2*i32)
+BYTES_PER_CLIENT = 20
+
+
+def _gini(values: np.ndarray) -> float:
+    """Gini coefficient of a non-negative sample (0 = perfectly even
+    participation, -> 1 = one client absorbs everything)."""
+    x = np.sort(np.asarray(values, np.float64))
+    n = x.size
+    total = float(x.sum())
+    if n == 0 or total <= 0.0:
+        return 0.0
+    i = np.arange(1, n + 1, dtype=np.float64)
+    return float(((2.0 * i - n - 1.0) * x).sum() / (n * total))
+
+
+class ClientProfiler:
+    """Bounded array-backed per-client profile store (module docstring)."""
+
+    def __init__(self, capacity_hint: int = 1024,
+                 max_clients: int = 2_097_152, ema_alpha: float = 0.2):
+        if max_clients < 1:
+            raise ValueError(f"max_clients must be >= 1, got {max_clients}")
+        if not 0.0 < ema_alpha <= 1.0:
+            raise ValueError(f"ema_alpha must be in (0, 1], got {ema_alpha}")
+        self.max_clients = int(max_clients)
+        self.ema_alpha = float(ema_alpha)
+        self._cap = min(max(int(capacity_hint), 16), self.max_clients)
+        self._lock = threading.Lock()
+        self._alloc(self._cap)
+        #: highest observed id + 1 (the live prefix of the arrays)
+        self._n = 0
+        #: ids rejected by the max_clients bound (surfaced, never indexed)
+        self.dropped = 0
+        #: highest round index ever observed (staleness base)
+        self.last_round = -1
+
+    def _alloc(self, cap: int) -> None:
+        self._ema_train_ms = np.zeros(cap, np.float32)
+        self._upload_bytes = np.zeros(cap, np.float64)
+        self._participation = np.zeros(cap, np.int32)
+        self._last_seen = np.full(cap, -1, np.int32)
+
+    def _ensure(self, n: int) -> None:
+        if n <= self._cap:
+            return
+        cap = self._cap
+        while cap < n:
+            cap *= 2
+        cap = min(cap, self.max_clients)
+        for name in ("_ema_train_ms", "_upload_bytes", "_participation",
+                     "_last_seen"):
+            old = getattr(self, name)
+            new = (np.full(cap, -1, old.dtype) if name == "_last_seen"
+                   else np.zeros(cap, old.dtype))
+            new[: old.size] = old
+            setattr(self, name, new)
+        self._cap = cap
+
+    def reset(self) -> None:
+        """Zero every profile (bench phase boundaries); capacity is kept."""
+        with self._lock:
+            self._alloc(self._cap)
+            self._n = 0
+            self.dropped = 0
+            self.last_round = -1
+
+    # -- feed ---------------------------------------------------------------
+
+    def observe(self, client_ids, round_idx: int, *, train_ms=None,
+                upload_bytes=None) -> None:
+        """Record one participation event for each id in ``client_ids``.
+
+        ``train_ms`` / ``upload_bytes`` are scalars (shared by the batch —
+        the sim paradigm's amortized round wall) or per-id arrays (the edge
+        server's per-upload attribution). A client's FIRST observation seeds
+        its EMA directly; later ones blend with ``ema_alpha``. Ids must be
+        unique within one call (cohorts are)."""
+        ids = np.atleast_1d(np.asarray(client_ids, np.int64))
+        if ids.size == 0:
+            return
+        with self._lock:
+            bad = (ids < 0) | (ids >= self.max_clients)
+            if bad.any():
+                self.dropped += int(bad.sum())
+                keep = ~bad
+                ids = ids[keep]
+                if train_ms is not None and np.ndim(train_ms):
+                    train_ms = np.asarray(train_ms)[keep]
+                if upload_bytes is not None and np.ndim(upload_bytes):
+                    upload_bytes = np.asarray(upload_bytes)[keep]
+                if ids.size == 0:
+                    return
+            self._ensure(int(ids.max()) + 1)
+            self._n = max(self._n, int(ids.max()) + 1)
+            first = self._participation[ids] == 0
+            self._participation[ids] += 1
+            self._last_seen[ids] = int(round_idx)
+            self.last_round = max(self.last_round, int(round_idx))
+            if train_ms is not None:
+                t = np.asarray(train_ms, np.float32)
+                a = self.ema_alpha
+                prev = self._ema_train_ms[ids]
+                self._ema_train_ms[ids] = np.where(
+                    first, t, (1.0 - a) * prev + a * t)
+            if upload_bytes is not None:
+                self._upload_bytes[ids] += np.asarray(upload_bytes, np.float64)
+
+    # -- queries ------------------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        """Measured store footprint (the bound the tests pin)."""
+        return int(self._ema_train_ms.nbytes + self._upload_bytes.nbytes
+                   + self._participation.nbytes + self._last_seen.nbytes)
+
+    @property
+    def clients_seen(self) -> int:
+        return int((self._participation[: self._n] > 0).sum())
+
+    def _seen_ids(self) -> np.ndarray:
+        return np.nonzero(self._participation[: self._n] > 0)[0]
+
+    def speed_rank(self, k: Optional[int] = None,
+                   slowest_first: bool = True) -> np.ndarray:
+        """Seen client ids ordered by EMA train-ms — the observed-speed
+        ranking a heterogeneity-aware cohort scheduler consumes. Ties keep
+        id order (stable sort) so the ranking is deterministic."""
+        with self._lock:
+            ids = self._seen_ids()
+            ema = self._ema_train_ms[ids]
+        order = np.argsort(-ema if slowest_first else ema, kind="stable")
+        out = ids[order]
+        return out if k is None else out[: int(k)]
+
+    def staleness(self, round_idx: Optional[int] = None) -> np.ndarray:
+        """``[ids, rounds_since_last_seen]`` (2 x n_seen) — the FedBuff
+        staleness signal, relative to ``round_idx`` (default: the newest
+        observed round)."""
+        with self._lock:
+            ids = self._seen_ids()
+            last = self._last_seen[ids]
+        base = self.last_round if round_idx is None else int(round_idx)
+        return np.stack([ids, base - last.astype(np.int64)])
+
+    def participation_fairness(self) -> dict:
+        """Participation-count spread over the SEEN clients: a sampling
+        audit (gini 0 = every seen client trained equally often)."""
+        with self._lock:
+            part = self._participation[: self._n]
+            part = part[part > 0]
+        if part.size == 0:
+            return {"clients_seen": 0, "gini": 0.0, "min": 0, "max": 0,
+                    "mean": 0.0}
+        return {"clients_seen": int(part.size),
+                "gini": round(_gini(part), 4),
+                "min": int(part.min()), "max": int(part.max()),
+                "mean": round(float(part.mean()), 3)}
+
+    def aggregates(self, round_idx: Optional[int] = None,
+                   top_k: int = 5) -> dict:
+        """Compact round-boundary summary for the pulse stream / fedtop:
+        counts, participation fairness, EMA train-ms distribution, the
+        ``top_k`` slowest clients, staleness spread, store footprint."""
+        with self._lock:
+            n = self._n
+            part = self._participation[:n]
+            seen = part > 0
+            ns = int(seen.sum())
+            out = {"clients_seen": ns, "store_bytes": self.nbytes,
+                   "dropped_ids": int(self.dropped)}
+            if ns == 0:
+                return out
+            ids = np.nonzero(seen)[0]
+            ema = self._ema_train_ms[ids]
+            last = self._last_seen[ids]
+            upload = float(self._upload_bytes[:n].sum())
+            pseen = part[ids]
+        out["participation"] = {
+            "mean": round(float(pseen.mean()), 3), "max": int(pseen.max()),
+            "gini": round(_gini(pseen), 4)}
+        if upload > 0:
+            out["upload_mb"] = round(upload / 1e6, 3)
+        if float(ema.max(initial=0.0)) > 0.0:
+            out["ema_train_ms"] = {
+                "mean": round(float(ema.mean()), 3),
+                "p50": round(float(np.percentile(ema, 50)), 3),
+                "p95": round(float(np.percentile(ema, 95)), 3)}
+            order = np.argsort(-ema, kind="stable")[: int(top_k)]
+            out["stragglers"] = [
+                {"client": int(ids[j]), "ema_ms": round(float(ema[j]), 3),
+                 "rounds": int(pseen[j])} for j in order]
+        base = self.last_round if round_idx is None else int(round_idx)
+        st = base - last.astype(np.int64)
+        out["staleness"] = {"mean": round(float(st.mean()), 3),
+                            "max": int(st.max())}
+        return out

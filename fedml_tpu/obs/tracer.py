@@ -364,11 +364,14 @@ def configure_from(config) -> bool:
     DISABLES tracing left on by an earlier run in the same process (its
     events would otherwise append into the previous run's trace files).
     Only a config without the attribute at all leaves tracing untouched."""
-    # fedcost rides the same entry-point hook: a config carrying
-    # cost_attribution configures static roofline attribution here too
+    # fedcost and fedpulse ride the same entry-point hook: a config
+    # carrying cost_attribution / pulse_path configures static roofline
+    # attribution and the live telemetry plane here too
     from fedml_tpu.obs import cost as _cost
+    from fedml_tpu.obs import live as _live
 
     _cost.configure_from(config)
+    _live.configure_from(config)
     trace_dir = getattr(config, "trace_dir", _NO_TRACE_DIR)
     if trace_dir is _NO_TRACE_DIR:
         return tracing_enabled()
@@ -447,7 +450,9 @@ def flush_all(trace_dir: Optional[str] = None) -> list[str]:
 
 
 def reset() -> None:
-    """Drop all tracers and disable tracing (tests; never mid-run)."""
+    """Drop all tracers and disable tracing (tests; never mid-run). Also
+    tears down the fedpulse plane — a plane leaked across tests would feed
+    every later run_round in the process."""
     global _ENABLED, _TRACE_DIR, _TRACE_ID, _PROCESS
     with _lock:
         _ENABLED = False
@@ -455,3 +460,6 @@ def reset() -> None:
         _TRACE_ID = None
         _PROCESS = None
         _TRACERS.clear()
+    from fedml_tpu.obs import live as _live
+
+    _live.reset()

@@ -101,3 +101,16 @@ def pytest_sessionfinish(session, exitstatus):
     slowest = sorted(_FILE_SECONDS.items(), key=lambda kv: -kv[1])[:10]
     emit("[t1] file-seconds: " + json.dumps(
         [[p, round(s, 1)] for p, s in slowest]))
+    # fedpulse session digest: one line when any test streamed a pulse —
+    # a silent drop of pulse coverage (or an unexpected critical health
+    # event inside the suite) shows up in the tier-1 log itself
+    try:
+        from fedml_tpu.obs.live import session_stats
+
+        st = session_stats()
+        if st["snapshots"]:
+            emit(f"[t1] pulse: {st['snapshots']} snapshot(s) over "
+                 f"{st['runs']} run(s), {st['critical']} critical health "
+                 f"event(s), last {st['last_path']}")
+    except Exception:
+        pass

@@ -43,6 +43,11 @@ METRICS = {
     "clients_per_sec": (
         lambda j: (j.get("crossdevice") or {}).get("clients_per_sec"),
         "cross-device clients/s"),
+    # MAC-basis MFU over the fedcost lane ceiling (in the tail since the
+    # PR-6 roofline block): the schedule-quality headline — a drop means
+    # the round program stopped filling the lanes the model shapes allow
+    "mfu_vs_lane_ceiling": (
+        lambda j: j.get("mfu_vs_lane_ceiling"), "mfu/ceiling"),
 }
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")

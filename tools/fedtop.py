@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""fedtop: a terminal dashboard over a fedpulse stream.
+
+Tails the ``pulse.jsonl`` a run writes under ``--pulse_path`` (obs/live)
+and renders the federation's live state: round progress and rates
+(rounds/s, clients/s), train/eval loss, MAC-basis MFU against the fedcost
+lane ceiling, wire anomalies, the per-client profile summary with the
+top-k stragglers, and the health watchdog's verdict:
+
+    python tools/fedtop.py /tmp/run/pulse.jsonl            # live (1s poll)
+    python tools/fedtop.py /tmp/run/pulse.jsonl --once     # one snapshot
+
+``--once`` renders the file's final state and exits — the CI mode (and the
+goldenable one: output derives ONLY from file contents, never the wall
+clock). Live mode redraws on every appended snapshot and flags a stream
+that stopped moving (no new snapshot for ``--stall`` seconds).
+
+Exit codes (``--once``): 0 healthy/warn; 1 the stream's health state is
+critical; 2 no file / no parseable snapshots. Live mode exits 0 on Ctrl-C.
+
+Pure text over the JSONL contract — no jax import, no fedml_tpu import, so
+it can run on a laptop against a file rsync'd (or tail -f | ssh'd) from
+the TPU host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def read_snapshots(path: str, offset: int = 0) -> tuple[list[dict], int]:
+    """Parse snapshots from byte ``offset`` on; returns (snaps, new offset).
+    A trailing torn line (mid-append reader) is left for the next poll."""
+    snaps: list[dict] = []
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return snaps, offset
+    end = data.rfind(b"\n") + 1
+    for line in data[:end].splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snap = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(snap, dict) and "round" in snap:
+            snaps.append(snap)
+    return snaps, offset + end
+
+
+def _rates(snaps: list[dict]) -> dict:
+    """rounds/s + clients/s from the last two snapshots' own timestamps
+    (prefer the exporter's figures; derive when absent) — file-only, so
+    ``--once`` output is reproducible."""
+    last = snaps[-1]
+    if last.get("rates"):
+        return last["rates"]
+    if len(snaps) < 2:
+        return {}
+    prev = snaps[-2]
+    dt_s = (last.get("ts_ms", 0) - prev.get("ts_ms", 0)) / 1e3
+    if dt_s <= 0:
+        return {}
+    dr = last.get("round", 0) - prev.get("round", 0)
+    out = {"rounds_per_s": round(dr / dt_s, 4)}
+    if last.get("cohort"):
+        out["clients_per_s"] = round(dr * last["cohort"] / dt_s, 2)
+    return out
+
+
+#: wire counters worth a dashboard line, rendered in this order
+_WIRE_KEYS = ("retransmits", "gave_up", "dup_dropped", "stale_uploads",
+              "uploads", "workers_alive")
+
+
+def render(snaps: list[dict], path: str, stalled_s: float = 0.0) -> str:
+    last = snaps[-1]
+    health = last.get("health") or {}
+    state = (health.get("state") or "ok").upper()
+    lines = [
+        f"fedpulse {os.path.basename(path)} · source {last.get('source')}"
+        f" · round {last.get('round')} · {len(snaps)} snapshot(s)"
+        f" · health {state}"
+        + (f" · STALLED {stalled_s:.0f}s" if stalled_s else "")
+    ]
+    rates = _rates(snaps)
+    rate_bits = []
+    if rates.get("rounds_per_s") is not None:
+        rate_bits.append(f"{rates['rounds_per_s']:g} rounds/s")
+    if rates.get("clients_per_s") is not None:
+        rate_bits.append(f"{rates['clients_per_s']:g} clients/s")
+    row = "rates     : " + (" · ".join(rate_bits) if rate_bits else "n/a")
+    if last.get("round_ms") is not None:
+        row += f"   round {last['round_ms']:.0f} ms"
+    if last.get("cohort"):
+        row += f"   cohort {last['cohort']}"
+    lines.append(row)
+    losses = [s.get("loss") for s in snaps if s.get("loss") is not None]
+    if losses:
+        lines.append(f"loss      : {losses[-1]:.6g}"
+                     + (f"   (first {losses[0]:.6g})" if len(losses) > 1
+                        else ""))
+    cost = last.get("cost") or {}
+    if cost.get("achieved_gflops_per_sec") is not None:
+        row = f"compute   : {cost['achieved_gflops_per_sec']:g} GFLOP/s"
+        if cost.get("mfu_mac") is not None:
+            row += f" · mfu {cost['mfu_mac'] * 100:.2f}% MAC"
+            if cost.get("mfu_vs_ceiling") is not None:
+                row += (f" ({cost['mfu_vs_ceiling'] * 100:.0f}% of the "
+                        f"{cost.get('out_lane_ceiling', 0) * 100:.1f}% "
+                        "lane ceiling)")
+        row += f"   [{cost.get('program')}]"
+        lines.append(row)
+    wire = (last.get("lanes") or {}).get("wire") or {}
+    bits = [f"{k} {wire[k]}" for k in _WIRE_KEYS if k in wire]
+    if bits:
+        lines.append("wire      : " + " · ".join(bits))
+    prof = last.get("profile") or {}
+    if prof.get("clients_seen"):
+        part = prof.get("participation") or {}
+        row = (f"clients   : {prof['clients_seen']} seen"
+               f" · participation mean {part.get('mean', 0):g}"
+               f" / max {part.get('max', 0)}"
+               f" / gini {part.get('gini', 0):g}")
+        st = prof.get("staleness") or {}
+        if st:
+            row += (f" · staleness mean {st.get('mean', 0):g}"
+                    f" / max {st.get('max', 0)}")
+        lines.append(row)
+        lines.append(f"profile   : store {prof.get('store_bytes', 0) / 1e6:.2f} MB"
+                     + (f" · {prof['dropped_ids']} id(s) beyond cap"
+                        if prof.get("dropped_ids") else "")
+                     + (f" · upload {prof['upload_mb']:g} MB"
+                        if prof.get("upload_mb") else ""))
+        strag = prof.get("stragglers") or []
+        if strag:
+            lines.append("stragglers: " + " · ".join(
+                f"#{s['client']} {s['ema_ms']:g}ms(x{s['rounds']})"
+                for s in strag))
+    events = [e for s in snaps
+              for e in (s.get("health") or {}).get("events", ())]
+    if events:
+        lines.append(f"health    : {len(events)} event(s), last "
+                     f"{min(3, len(events))}:")
+        for e in events[-3:]:
+            lines.append(f"  r{e.get('round')} {e.get('severity', ''):>8} "
+                         f"{e.get('rule')} — {e.get('detail')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("pulse", help="pulse.jsonl written by --pulse_path")
+    ap.add_argument("--once", action="store_true",
+                    help="render the final state once and exit (CI mode)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="live-mode poll seconds (default 1.0)")
+    ap.add_argument("--stall", type=float, default=30.0,
+                    help="live mode: flag the stream after this many "
+                         "seconds without a new snapshot")
+    args = ap.parse_args(argv)
+
+    snaps, offset = read_snapshots(args.pulse)
+    if args.once:
+        if not snaps:
+            print(f"fedtop: no pulse snapshots in {args.pulse}",
+                  file=sys.stderr)
+            return 2
+        print(render(snaps, args.pulse))
+        state = (snaps[-1].get("health") or {}).get("state")
+        return 1 if state == "critical" else 0
+
+    last_new = time.monotonic()
+    try:
+        while True:
+            if snaps:
+                stalled = time.monotonic() - last_new
+                body = render(snaps, args.pulse,
+                              stalled_s=stalled if stalled > args.stall
+                              else 0.0)
+                sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
+            else:
+                sys.stdout.write(f"fedtop: waiting for {args.pulse} ...\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+            fresh, offset = read_snapshots(args.pulse, offset)
+            if fresh:
+                snaps.extend(fresh)
+                # bound live-mode memory on a weeks-long stream
+                del snaps[:-4096]
+                last_new = time.monotonic()
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,10 +29,12 @@ import sys
 #: the ROADMAP tier-1 gate's own progress-line shape — keep identical so
 #: this tool and the gate can never disagree about DOTS
 DOTS_RE = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
-#: passed-in-window baseline the ROADMAP gate tracks (PR 4 moved 173 ->
-#: 214 with the persistent compile cache); the report prints the delta so
-#: a budget regression is visible in the same line as the count
-BASELINE_DOTS = 214
+#: passed-in-window baseline the ROADMAP gate tracks: the PR-6 GREEN state
+#: (397 passed / 6 xfailed inside the 870s budget — the slow-mark + xfail
+#: pass that first made the gate exit 0). PR 4's 214 was the pre-green
+#: compile-cache waypoint; deltas against it read as phantom progress. A
+#: count BELOW this baseline is flagged as a regression in the report.
+BASELINE_DOTS = 397
 SUMMARY_RE = re.compile(
     r"^=+ .*(passed|failed|error|no tests ran).* =+$"
     r"|^\d+ (passed|failed|error)[^=]*in [0-9.]+m?s.*$")
@@ -75,6 +77,7 @@ def parse_log(text: str) -> dict:
         "dots": dots,
         "dots_baseline": BASELINE_DOTS,
         "dots_delta": dots - BASELINE_DOTS,
+        "dots_regression": dots < BASELINE_DOTS,
         "progress_lines": progress_lines,
         "summary": summary,
         "failures": failures,
@@ -87,6 +90,12 @@ def format_report(rep: dict) -> str:
     lines = [f"tier-1 log digest: DOTS={rep['dots']}"
              f" ({rep['dots_delta']:+d} vs the {rep['dots_baseline']} "
              f"baseline, over {rep['progress_lines']} progress line(s))"]
+    if rep.get("dots_regression"):
+        lines.append(
+            f"DOTS REGRESSION: {rep['dots']} is below the PR-6 green "
+            f"baseline of {rep['dots_baseline']} — the gate lost passing "
+            "tests (budget overrun or new failures); see slowest files "
+            "and failures below")
     if rep["summary"]:
         lines.append(f"summary: {rep['summary']}")
     if rep["compile_cache"]:

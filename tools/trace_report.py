@@ -33,7 +33,14 @@ Report sections:
   (and MFU on TPU) against measured device spans / round walls,
 - device memory: per-rank high-water of the round-boundary sampler lane,
 - wire anomalies: retransmits / gave_up / dup_dropped / chaos counters,
-- overlap_frac per round (host pipeline stage counters, where present).
+- overlap_frac per round (host pipeline stage counters, where present),
+- per-client profiles (fedpulse join): when a ``pulse.jsonl`` sits beside
+  the trace files (a run with BOTH ``--trace_dir`` and ``--pulse_path``
+  pointing into the same directory), the straggler story extends below
+  rank granularity — the profiler's per-client EMA train-ms ranking,
+  participation fairness, and the stream's health verdict join the
+  per-rank causal-chain ranking. Absent the file, the report (and every
+  existing golden) is unchanged.
 
 Exit codes: 0 clean; 1 structural anomalies — unclosed spans, rounds
 missing on some rank, recv spans with no matching send (span imbalance) —
@@ -51,9 +58,13 @@ import json
 import os
 import sys
 from collections import defaultdict
+from typing import Optional
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_TOOLS_DIR, ".."))
+sys.path.insert(0, _TOOLS_DIR)   # fedtop (pulse.jsonl parsing) lives beside us
 
+from fedtop import read_snapshots  # noqa: E402
 from fedml_tpu.obs.cost import roofline as cost_roofline  # noqa: E402
 from fedml_tpu.obs.export import read_jsonl, write_chrome_trace  # noqa: E402
 
@@ -394,6 +405,34 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
     return rep
 
 
+def load_pulse(trace_dir: str) -> Optional[list]:
+    """Snapshots from a ``pulse.jsonl`` beside the trace files, or None.
+    The parsing (skip blanks/torn lines, keep round-carrying dicts) is
+    fedtop's ``read_snapshots`` — ONE implementation of the JSONL
+    contract, so the two tools can never diverge on what they accept."""
+    path = os.path.join(trace_dir, "pulse.jsonl")
+    if not os.path.exists(path):
+        return None
+    snaps, _offset = read_snapshots(path)
+    return snaps or None
+
+
+def client_profiles_section(snaps: list) -> dict:
+    """The fedpulse join: per-client straggler ranking + fairness from the
+    stream's LAST snapshot (profiles are cumulative), health across all."""
+    last = snaps[-1]
+    critical = sum(1 for s in snaps
+                   for e in (s.get("health") or {}).get("events", ())
+                   if e.get("severity") == "critical")
+    return {
+        "snapshots": len(snaps),
+        "last_round": last.get("round"),
+        "profile": last.get("profile") or {},
+        "health_state": (last.get("health") or {}).get("state"),
+        "critical_events": critical,
+    }
+
+
 def _worker_chain(round_span: dict, rank, span_by_sid, sends,
                   sends_by_parent, recvs):
     """One worker's causal chain for a round, in ms. Returns None when the
@@ -459,6 +498,25 @@ def format_report(rep: dict) -> str:
             lines.append(f"  rank {s['rank']!s:>6}  "
                          f"{s['mean_chain_ms']:>9.1f} ms"
                          f"  over {s['rounds']} round(s)")
+    cp = rep.get("client_profiles")
+    if cp:
+        prof = cp.get("profile") or {}
+        lines.append("")
+        lines.append(
+            f"per-client profiles (fedpulse join, {cp['snapshots']} "
+            f"snapshot(s) through round {cp['last_round']}):")
+        part = prof.get("participation") or {}
+        if prof.get("clients_seen"):
+            lines.append(
+                f"  {prof['clients_seen']} client(s) seen · participation "
+                f"mean {part.get('mean', 0):g} / max {part.get('max', 0)} / "
+                f"gini {part.get('gini', 0):g}")
+        for s in prof.get("stragglers") or []:
+            lines.append(f"  client #{s['client']:>8}  "
+                         f"{s['ema_ms']:>9.1f} ms EMA"
+                         f"  over {s['rounds']} round(s)")
+        lines.append(f"  health: {cp.get('health_state') or 'n/a'}, "
+                     f"{cp['critical_events']} critical event(s)")
     costsec = rep.get("cost")
     if costsec:
         lines.append("")
@@ -552,6 +610,11 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     rep = analyze(events, expect_ranks=args.expect_ranks)
+    pulse = load_pulse(args.trace_dir)
+    if pulse:
+        # additive join: exit codes and the span-graph sections are
+        # untouched — a pulse-less trace dir reports exactly as before
+        rep["client_profiles"] = client_profiles_section(pulse)
     if args.perfetto:
         write_chrome_trace(args.perfetto, events)
         rep["perfetto"] = args.perfetto
