@@ -165,6 +165,67 @@ def _bench_crosssilo(tiny: bool, model: str, rounds: int, batch: int,
     }
 
 
+def _bench_packed_conv_ab(ds, base_cfg, model: str, rounds: int, peak):
+    """fedpack flagship A/B (ops/packed_conv.py): the SAME packed-schedule
+    round measured under the per-lane vmap lowering ('off') and the
+    client-packed lowering (BENCH_PACKED_CONV_MODE, default 'blockdiag') —
+    per-lowering real img/s, the packed program's static output-lane
+    ceiling (the lift the packing buys) and, when a TPU peak is known,
+    measured USEFUL-basis MFU vs that ceiling. On the CPU container this
+    block is a structural/no-regression check (the >=1.5x img/s claim is
+    asserted only on the TPU bench host, docs/perf.md 'Client packing')."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.models import create_model
+    from fedml_tpu.obs import cost as fedcost
+
+    mode = os.environ.get("BENCH_PACKED_CONV_MODE", "blockdiag")
+    out = {"mode": mode, "img_per_sec": {}, "mfu_vs_lane_ceiling": {},
+           "mfu_mac_useful": {}}
+    ceilings = {}
+    for arm in dict.fromkeys(("off", mode)):
+        # force residency so the CPU smoke exercises the same packed
+        # (device-resident) schedule branch the TPU run measures
+        cfg = base_cfg.replace(packed_conv=arm, device_data="on")
+        bundle = create_model(model, 10, dtype=jnp.bfloat16,
+                              input_shape=ds.train_x.shape[2:],
+                              bn_impl=os.environ.get("BENCH_BN", "xla"),
+                              conv_impl=os.environ.get("BENCH_CONV", "xla"))
+        fedcost.reset_cost_tables()
+        api = FedAvgAPI(ds, cfg, bundle)
+        for _pass in range(2):        # same two-pass warm as the headline
+            for r in range(1, rounds + 1):
+                last = api.run_round(r)
+            float(last)
+        t0 = time.perf_counter()
+        for r in range(1, rounds + 1):
+            last = api.run_round(r)
+        float(last)
+        dt = time.perf_counter() - t0
+        real = sum(api.round_counts(r)[0] for r in range(1, rounds + 1))
+        out["img_per_sec"][arm] = round(real * EPOCHS / dt, 1)
+        rec = max(fedcost.cost_tables().values(),
+                  key=lambda r: r["summary"]["gemm_flops_per_invocation"],
+                  default=None)
+        if rec is not None:
+            ceilings[arm] = rec["summary"]["out_lane_ceiling"]
+            rf = fedcost.roofline(rec["summary"], dt, invocations=rounds,
+                                  peak=peak)
+            out["mfu_vs_lane_ceiling"][arm] = rf.get("mfu_vs_ceiling")
+            out["mfu_mac_useful"][arm] = rf.get("mfu_mac_useful",
+                                                rf.get("mfu_mac"))
+    off = out["img_per_sec"].get("off")
+    on = out["img_per_sec"].get(mode)
+    out["speedup"] = round(on / off, 3) if (off and on) else None
+    # the packed program's static ceiling — the lane lift the packing buys
+    # (bench_report tracks this across the artifact series)
+    out["out_lane_ceiling"] = ceilings.get(mode)
+    out["off_lane_ceiling"] = ceilings.get("off")
+    return out
+
+
 def _bench_crossdevice(tiny: bool):
     """Cross-device paradigm at the reference's own scale: 342,477 logical
     clients, 50 sampled per round (stackoverflow row,
@@ -410,6 +471,15 @@ def main():
         if pulse_plane.profiler is not None:
             pulse_plane.profiler.reset()
 
+    # fedpack flagship A/B (ISSUE 9): both packed-conv lowerings measured
+    # through the same harness, embedded as the `packed_conv` block. Runs
+    # AFTER the flagship snapshot (it resets the cost tables per arm) and
+    # before the paradigm benches re-enable their own attribution records.
+    packed_conv_ab = None
+    if not os.environ.get("BENCH_NO_PACKED_AB"):
+        packed_conv_ab = _bench_packed_conv_ab(ds, cfg, model, rounds, peak)
+        fedcost.reset_cost_tables()   # paradigm benches attribute fresh
+
     # Cross-silo paradigm on the same hardware (VERDICT r2 #3): the north
     # star names DISTRIBUTED FedAvg, so measure the shard_map mesh path too —
     # full participation (the standard silo deployment), dataset resident and
@@ -553,6 +623,9 @@ def main():
         # model's GEMM shapes allow (1.0 = lanes are the only limit) —
         # both sides of the division count GEMM multiply-accumulates only
         "mfu_vs_lane_ceiling": mfu_vs_lane_ceiling,
+        # fedpack A/B (ops/packed_conv.py): per-lowering real img/s, the
+        # packed program's lifted static lane ceiling, useful-basis MFU
+        "packed_conv": packed_conv_ab,
         # fedpulse end-of-run profiler aggregates for the flagship pass
         # (the cross-device block embeds its own at 342k-client scale)
         "profiler": flagship_profiler,

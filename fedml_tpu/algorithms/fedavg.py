@@ -482,12 +482,14 @@ class FedAvgAPI:
         return plan
 
     def build_round_step_packed(self, shape_key: tuple):
-        from fedml_tpu.parallel.packed import make_packed_cohort_train
+        from fedml_tpu.parallel.packed import (make_packed_cohort_train,
+                                               packed_conv_active)
 
+        c = self.config
         n_pad = int(self.dataset.train_x.shape[1])
         packed = make_packed_cohort_train(
             self.bundle, self.task, n_pad, shape_key,
-            **self._local_train_kwargs())
+            packed_conv=c.packed_conv, **self._local_train_kwargs())
 
         @jax.jit
         def round_step(variables, tx, ty, tm, rows, weights, rng, plan_arrays):
@@ -500,6 +502,15 @@ class FedAvgAPI:
                 acc, variables)
             return new_vars, acc_loss / denom
 
+        # fedcost packing hint (obs/cost.attribute_program): the joint
+        # form's block-diag dots stream n_lanes x the useful FLOPs; the
+        # per-lane vmap form's grouped convs fold the same n_lanes clients
+        # (H4) — either way the program folds shape_key[0] clients per op
+        active = packed_conv_active(self.bundle, c.packed_conv,
+                                    c.client_optimizer)
+        round_step.cost_hints = {
+            "packed_conv": c.packed_conv if active else "off",
+            "packing_factor": int(shape_key[0])}
         return round_step
 
     def _run_packed_round(self, sampled, live, rk):
@@ -1077,6 +1088,7 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         when packing doesn't apply (falls back to grouped/sharded)."""
         from fedml_tpu.parallel.packed import (
             make_crosssilo_packed_round,
+            packed_conv_active,
             plan_packing_mesh,
         )
 
@@ -1134,11 +1146,23 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         # fedscope compile telemetry: the packed mesh program is the most
         # expensive build in the tree (shard_map over vmapped lanes); its
         # shape key is the lane geometry that determines the XLA program
-        round_fn = timed_build(
-            "mesh_packed_round", (n_pad, D, lanes_dev, plan.shape_key),
-            lambda: make_crosssilo_packed_round(
+        def _build():
+            rf = make_crosssilo_packed_round(
                 self.bundle, self.task, n_pad, self.mesh,
-                **hooks, **self._local_train_kwargs()))
+                packed_conv=c.packed_conv, **hooks,
+                **self._local_train_kwargs())
+            # fedcost packing hint: the per-DEVICE contraction folds
+            # lanes_dev clients (obs/cost.attribute_program)
+            active = packed_conv_active(self.bundle, c.packed_conv,
+                                        c.client_optimizer)
+            rf.cost_hints = {
+                "packed_conv": c.packed_conv if active else "off",
+                "packing_factor": int(plan.n_lanes // D)}
+            return rf
+
+        round_fn = timed_build(
+            "mesh_packed_round",
+            (n_pad, D, lanes_dev, plan.shape_key, c.packed_conv), _build)
         return dict(perm=perm, plan=plan, data=data, plan_arrays=plan_arrays,
                     counts_perm=np.asarray(ds.train_counts, np.float32)[perm],
                     round_fn=round_fn)
@@ -1332,6 +1356,9 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                                           rks, unroll=h)
             return v, s, losses
 
+        hints = getattr(pm["round_fn"], "cost_hints", None)
+        if hints is not None:
+            super_fn.cost_hints = hints  # fedpack: same packed GEMMs x h
         return super_fn
 
     def _run_superstep(self, start: int, blk: int, w):

@@ -167,6 +167,20 @@ class FedConfig:
     # algorithm's aggregation to be the plain weighted mean (falls back
     # with a warning otherwise).
     pack_lanes: int = 0
+    # fedpack conv lowering for the packed schedule's lane axis
+    # (ops/packed_conv.py): how the K co-scheduled lanes' same-shape convs
+    # reach the MXU. "off" (default) keeps the per-lane vmap (XLA lowers it
+    # to a grouped conv, docs/mfu_experiments.md H4); "blockdiag" runs ONE
+    # im2col block-diagonal GEMM per conv across all lanes (output lanes
+    # K*Cout, reduction lanes K*kh*kw*Cin — full MXU dims at K*C >= 128, at
+    # the price of K x streamed FLOPs, reported honestly by fedcost's
+    # packing_factor column); "grouped" runs one feature_group_count=K
+    # convolution (useful FLOPs only; XLA picks the MXU mapping). Applies
+    # wherever pack_lanes schedules lanes (sim + cross-silo mesh) for
+    # conv models with sgd clients; other configurations fall back to the
+    # per-lane vmap with a warning. Numerics match the vmap lowering up to
+    # GEMM summation order (tests/test_packed_conv.py).
+    packed_conv: str = "off"
     # Cross-silo super-step: fold H consecutive rounds into ONE jitted
     # program (lax.scan over round keys) on the packed resident-sharded
     # mesh path — amortizes the fixed per-round cost (dispatch + program
@@ -292,6 +306,10 @@ class FedConfig:
             raise ValueError(f"bucket_groups must be >= 1, got {self.bucket_groups}")
         if self.pack_lanes < 0:
             raise ValueError(f"pack_lanes must be >= 0, got {self.pack_lanes}")
+        if self.packed_conv not in ("off", "blockdiag", "grouped"):
+            raise ValueError(
+                f"packed_conv must be off|blockdiag|grouped, got "
+                f"{self.packed_conv!r}")
         if self.rounds_per_step < 1:
             raise ValueError(
                 f"rounds_per_step must be >= 1, got {self.rounds_per_step}")
@@ -463,6 +481,11 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                         "(docs/mfu_experiments.md H7); 1 = off")
     p.add_argument("--pack_lanes", type=int, default=defaults.pack_lanes,
                    help="pack the cohort into N scan lanes (0 = off)")
+    p.add_argument("--packed_conv", type=str, default=defaults.packed_conv,
+                   choices=("off", "blockdiag", "grouped"),
+                   help="fedpack conv lowering for the packed lanes: one "
+                        "block-diagonal GEMM / grouped conv across the K "
+                        "lanes instead of the per-lane vmap (off = vmap)")
     p.add_argument("--host_pipeline_depth", type=int,
                    default=defaults.host_pipeline_depth,
                    help="prefetch this many future rounds' cohorts on "

@@ -41,6 +41,13 @@ class ModelBundle:
     task: str = "classification"
     has_batch_stats: bool = False
     uses_dropout: bool = False
+    #: fedpack hook (ops/packed_conv.py): ``packed_variant(impl)`` returns a
+    #: TRAIN-ONLY bundle whose module consumes lane-major [K, N, ...] input
+    #: and whose parameter tree is the standard tree with a leading K axis
+    #: on every leaf (stack_variables/unstack_variables are the bridges).
+    #: None = this model family has no packed conv lowering; the packed
+    #: schedule keeps its per-lane vmap.
+    packed_variant: Optional[Callable[[str], "ModelBundle"]] = None
 
     def init(self, rng: jax.Array, batch_size: int = 2) -> dict:
         x = jnp.zeros((batch_size,) + tuple(self.input_shape), self.input_dtype)

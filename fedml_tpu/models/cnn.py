@@ -21,9 +21,14 @@ from fedml_tpu.models import ModelBundle, register_model
 class CNNOriginalFedAvg(nn.Module):
     output_dim: int = 62
     only_digits: bool = False
+    conv_impl: str = "xla"   # "packed": fedpack client-packed convs over a
+    #                          leading lane axis (ops/packed_conv.py)
+    packed_impl: str = "blockdiag"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.conv_impl == "packed":
+            return self._call_packed(x)
         if x.ndim == 2:  # flat 784 -> 28x28x1
             x = x.reshape((x.shape[0], 28, 28, 1))
         x = nn.Conv(32, (5, 5), padding="SAME")(x)
@@ -33,6 +38,32 @@ class CNNOriginalFedAvg(nn.Module):
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(512)(x))
         return nn.Dense(self.output_dim)(x)
+
+    def _call_packed(self, x):
+        """fedpack body (x [K, N, 28, 28, 1] or [K, N, 784] lane-major):
+        same submodule call order as the per-client body, so the parameter
+        tree is the standard tree with a leading K axis (ops/packed_conv
+        contract). Pooling folds the lane axis into the batch axis — it is
+        per-image work with no cross-lane terms."""
+        from fedml_tpu.ops.packed_conv import Conv as PConv
+        from fedml_tpu.ops.packed_conv import Dense as PDense
+
+        if x.ndim == 3:  # [K, N, 784] -> [K, N, 28, 28, 1]
+            x = x.reshape(x.shape[:2] + (28, 28, 1))
+        k = x.shape[0]
+
+        def pool(y):
+            flat = y.reshape((-1,) + y.shape[2:])
+            flat = nn.max_pool(nn.relu(flat), (2, 2), strides=(2, 2))
+            return flat.reshape((k, -1) + flat.shape[1:])
+
+        x = PConv(32, 5, impl=self.packed_impl)(x)
+        x = pool(x)
+        x = PConv(64, 5, impl=self.packed_impl)(x)
+        x = pool(x)
+        x = x.reshape(x.shape[:2] + (-1,))
+        x = nn.relu(PDense(512)(x))
+        return PDense(self.output_dim)(x)
 
 
 class CNNDropOut(nn.Module):
@@ -54,11 +85,20 @@ class CNNDropOut(nn.Module):
 
 @register_model("cnn")
 def _cnn(output_dim: int, **_):
-    return ModelBundle(
+    bundle = ModelBundle(
         name="cnn",
         module=CNNOriginalFedAvg(output_dim),
         input_shape=(28, 28, 1),
     )
+    # fedpack hook (ops/packed_conv.py): train-only lane-major twin for the
+    # packed schedule's joint-lane program (--packed_conv)
+    bundle.packed_variant = lambda impl: ModelBundle(
+        name="cnn_packed",
+        module=CNNOriginalFedAvg(output_dim, conv_impl="packed",
+                                 packed_impl=impl),
+        input_shape=(28, 28, 1),
+    )
+    return bundle
 
 
 @register_model("cnn_dropout")

@@ -111,8 +111,10 @@ def timed_build(name: str, shape_key, builder: Callable) -> Callable:
         return out
 
     # the packed mesh round carries its un-jitted body as `.raw` (the
-    # super-step scans it); keep such sidecar attributes reachable
-    raw = getattr(fn, "raw", None)
-    if raw is not None:
-        step.raw = raw
+    # super-step scans it) and fedpack programs carry fedcost packing
+    # hints as `.cost_hints`; keep such sidecar attributes reachable
+    for attr in ("raw", "cost_hints"):
+        val = getattr(fn, attr, None)
+        if val is not None:
+            setattr(step, attr, val)
     return step

@@ -470,10 +470,51 @@ def op_table(hlo_text: str) -> tuple[list[dict], bool]:
                 "out_lane_fill": _lane_fill(row["n"]),
                 "red_lane_fill": _lane_fill(row["k"]),
             })
+            # fedpack columns: packing_factor = co-scheduled clients folded
+            # into this op; useful_flops = FLOPs doing real per-client
+            # work. Defaults (1, = flops) — whether an op folds clients is
+            # program-level knowledge, filled in by apply_packing() from
+            # the builder's out-of-band hint (jax 0.4.37 drops name-stack
+            # metadata from HLO text, so ops carry no marker to parse).
+            row["packing_factor"] = 1
+            row["useful_flops"] = row["flops"]
             row["intensity"] = (row["flops"] / row["bytes"]
                                 if row["bytes"] else 0.0)
             ops.append(row)
     return ops, unknown
+
+
+def apply_packing(ops: list[dict], factor: int,
+                  impl: str = "blockdiag") -> list[dict]:
+    """Fill a client-packed program's packing columns (in place), given the
+    builder's hint that ``factor`` clients are folded per op.
+
+    - Grouped convs with ``groups == factor`` are the K-client folding
+      (the per-lane vmap's H4 lowering, or ops/packed_conv.conv_grouped);
+      their analytic FLOPs are already useful-only, so only the factor is
+      recorded. Patch-extraction/depthwise shapes (per-group N of 1, or
+      N == K — identity-kernel im2col machinery) are excluded.
+    - With ``impl == 'blockdiag'``, unbatched dots whose output AND
+      reduction dims are both multiples of ``factor`` are the block GEMMs
+      (ops/packed_conv.conv_blockdiag) — fwd (N = K*Co), dgrad (N = K*R)
+      and wgrad (N = K*Co) all qualify — streaming ``factor`` x the useful
+      FLOPs as structural zeros: ``useful_flops`` divides accordingly.
+
+    Hint-scoped by design: it only runs on programs whose builder attached
+    ``cost_hints``, never on arbitrary HLO.
+    """
+    if not factor or factor <= 1:
+        return ops
+    for o in ops:
+        if (o["kind"] == "conv" and o["groups"] == factor
+                and o["n"] > 1 and o["n"] != o["k"]):
+            o["packing_factor"] = int(factor)
+        elif (impl == "blockdiag" and o["kind"] == "dot"
+                and o.get("b", 1) == 1
+                and o["n"] % factor == 0 and o["k"] % factor == 0):
+            o["packing_factor"] = int(factor)
+            o["useful_flops"] = o["flops"] / factor
+    return ops
 
 
 def summarize(ops: list[dict], unknown_trip_counts: bool = False,
@@ -485,7 +526,9 @@ def summarize(ops: list[dict], unknown_trip_counts: bool = False,
     total = sum(o["flops"] * o["count"] for o in ops)
     if total <= 0:
         return {"gemm_ops": 0, "gemm_flops_per_invocation": 0.0,
+                "useful_flops_per_invocation": 0.0,
                 "out_lane_ceiling": None, "red_lane_ceiling": None,
+                "packing": None,
                 "by_output_channels": {}, "top_ops": [],
                 "unknown_trip_counts": unknown_trip_counts}
     out_ceiling = sum(o["flops"] * o["count"] * o["out_lane_fill"]
@@ -501,11 +544,21 @@ def summarize(ops: list[dict], unknown_trip_counts: bool = False,
         for n, f in sorted(by_n.items())
     }
     top = sorted(ops, key=lambda o: -o["flops"] * o["count"])[:top_k]
+    # fedpack accounting: streamed vs useful FLOPs. `.get` defaults keep
+    # hand-built op rows (tests, older callers) working unchanged.
+    useful = sum(o.get("useful_flops", o["flops"]) * o["count"] for o in ops)
+    max_factor = max((o.get("packing_factor", 1) for o in ops), default=1)
+    packing = None
+    if max_factor > 1:
+        packing = {"max_factor": int(max_factor),
+                   "useful_flops_frac": round(useful / total, 4)}
     return {
         "gemm_ops": len(ops),
         "gemm_flops_per_invocation": total,
+        "useful_flops_per_invocation": useful,
         "out_lane_ceiling": round(out_ceiling, 4),
         "red_lane_ceiling": round(red_ceiling, 4),
+        "packing": packing,
         "by_output_channels": stage,
         "top_ops": [
             {k: (round(v, 4) if isinstance(v, float) else v)
@@ -565,6 +618,17 @@ def roofline(summary: dict, measured_s: float, invocations: float = 1.0,
     ceiling = summary.get("out_lane_ceiling")
     if peak and ceiling:
         out["mfu_vs_ceiling"] = round((achieved / peak) / ceiling, 4)
+    # fedpack honesty: when the program streams structural zeros (block-
+    # diagonal packing), also report the USEFUL-work rates — the number
+    # comparable across lowerings (streamed MFU flatters a packed program
+    # by exactly its packing factor)
+    useful = summary.get("useful_flops_per_invocation")
+    if useful is not None and useful < flops / max(invocations, 1e-12):
+        u = useful * invocations
+        ach_u = u / measured_s if measured_s > 0 else 0.0
+        out["useful_gflops_per_sec"] = round(ach_u / 1e9, 2)
+        if peak:
+            out["mfu_mac_useful"] = round(ach_u / peak, 4)
     return out
 
 
@@ -629,10 +693,20 @@ def attribute_program(name: str, shape_key, fn, args) -> Optional[dict]:
         rep = analyze_jitted(fn, args)
         if rep is None:
             return None
+        # fedpack hint (ops/packed_conv.py): programs whose builder marked
+        # them as client-packed get their block-diag dots' packing_factor /
+        # useful-FLOP columns filled in and the summary recomputed
+        hints = getattr(fn, "cost_hints", None)
+        if hints and hints.get("packing_factor", 1) > 1:
+            apply_packing(rep["ops"], int(hints["packing_factor"]),
+                          hints.get("packed_conv", "blockdiag"))
+            rep["summary"] = summarize(
+                rep["ops"], rep["summary"]["unknown_trip_counts"])
         record = {
             "program": name,
             "shape_key": repr(shape_key),
             "path": PROGRAM_PATHS.get(name),
+            "packed_conv": (hints or {}).get("packed_conv"),
             "summary": rep["summary"],
             "xla_cost": rep["xla_cost"],
             "ops": rep["ops"],

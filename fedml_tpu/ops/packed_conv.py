@@ -1,0 +1,278 @@
+"""fedpack: K co-scheduled clients' same-shape convs as ONE contraction.
+
+The flagship's MFU story (docs/mfu_experiments.md H1/H4/H6, docs/perf.md
+roofline) is that ResNet-56's C=16/32/64 stages fill at most 12.5/25/50% of
+the 128-wide MXU output lanes, while the same stack measures 66% MFU at
+width 128 — and the per-lane ``vmap`` the packed schedule inherits leaves
+each client's conv a partial-lane GEMM (XLA lowers the batched-kernel vmap
+to a grouped conv and expands it block-diagonally on TPU, H4). This module
+uses the one dimension the federation has in abundance — clients — to fill
+the lanes the model can't: the K lanes of a packed cohort train through ONE
+MXU-shaped contraction per conv instead of K partial-lane ones.
+
+Primary lowering (``impl='blockdiag'``): im2col block-diagonal GEMM,
+
+    Y[P, K*Co] = P2[P, K*R] @ W_bd[K*R, K*Co],   R = kh*kw*Cin,
+
+with P = batch*out-pixels streaming the MXU, output lanes K*Co (>= 128 at
+K >= 8 for C=16) and reduction lanes K*R always full. ``W_bd`` is built
+INSIDE the forward from the stacked per-client kernels via an einsum with
+``eye(K)`` — off-diagonal blocks are structural zeros, so autodiff routes
+gradients only to each client's own kernel, and the dgrad/wgrad dots of the
+backward pass inherit the same full-lane shapes for free. The price is
+explicit: the GEMM streams K x the useful FLOPs (the off-diagonal zeros)
+and the patch matrix pays up to kh*kw x activation traffic —
+``obs/cost.py`` reports ``packing_factor``/useful-FLOP columns so MFU
+claims stay honest, and the A/B against the per-lane vmap (bench.py,
+tools/lanes_probe.py ``--mode packed``) adjudicates on the chip.
+
+Alternate lowering (``impl='grouped'``): one ``feature_group_count=K``
+convolution over channel-concatenated lanes — useful FLOPs only, but the
+MXU mapping is whatever XLA's grouped lowering picks (H4 measured the TPU
+backend expanding it block-diagonally anyway). Both lowerings are selected
+by ``--packed_conv {off,blockdiag,grouped}``; ``off`` keeps today's
+per-lane vmap.
+
+Layout contract: packed activations travel as [K, N, H, W, C] (lane-major
+NHWC) and packed parameters are the STANDARD parameter tree with a leading
+K axis on every leaf (:func:`stack_variables` / :func:`unstack_variables`
+are bit-exact inverses). The flax modules below are named ``Conv`` /
+``BatchNorm`` / ``Dense`` so auto-naming produces the same parameter paths
+as the standard NHWC models — ``conv_impl='packed'`` models share their
+per-client parameter pytree with the standard models leaf-for-leaf
+(mirroring the ``_w2``/``_w2_inv`` contract of ops/conv_lanes.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "stack_variables", "unstack_variables",
+    "block_diag_weight", "block_diag_unstack",
+    "conv_blockdiag", "conv_grouped", "conv_vmap",
+    "Conv", "BatchNorm", "Dense",
+]
+
+
+# -- stacked-tree helpers (the packing contract, DESIGN.md §15) ---------------
+
+def stack_variables(variables: dict, k: int) -> dict:
+    """Standard variable tree -> packed tree: every leaf gains a leading
+    lane axis holding ``k`` identical copies (each lane starts the round
+    from the same global model)."""
+    return jax.tree.map(
+        lambda v: jnp.broadcast_to(v[None], (k,) + v.shape), variables)
+
+
+def unstack_variables(stacked: dict, lane: int) -> dict:
+    """Packed tree -> lane ``lane``'s standard tree (bit-exact inverse of
+    :func:`stack_variables` for any lane)."""
+    return jax.tree.map(lambda v: v[lane], stacked)
+
+
+# -- block weight stack/unstack (mirrors _w2/_w2_inv in conv_lanes.py) -------
+
+def _w2p(w: jnp.ndarray) -> jnp.ndarray:
+    """[kh,kw,Ci,Co] -> [Co, Ci*kh*kw] in PATCH row order (channel-major:
+    row index = c*kh*kw + tap, matching lax.conv_general_dilated_patches)."""
+    kh, kw, ci, co = w.shape
+    return w.transpose(3, 2, 0, 1).reshape(co, ci * kh * kw)
+
+
+def _w2p_inv(w2: jnp.ndarray, kh: int, kw: int, ci: int, co: int) -> jnp.ndarray:
+    """[Co, Ci*kh*kw] -> [kh,kw,Ci,Co] (inverse of :func:`_w2p`)."""
+    return w2.reshape(co, ci, kh, kw).transpose(2, 3, 1, 0)
+
+
+def block_diag_weight(ws: jnp.ndarray) -> jnp.ndarray:
+    """Stacked per-client kernels [K,kh,kw,Ci,Co] -> the block weight
+    W_bd[K*R, K*Co] (R = Ci*kh*kw) whose diagonal blocks are the clients'
+    im2col kernels and whose off-diagonal blocks are structural zeros.
+
+    Built with an ``eye(K)`` einsum rather than scatter so gradients flow
+    ONLY to the diagonal blocks: client separation survives SGD exactly.
+    """
+    k, kh, kw, ci, co = ws.shape
+    w2s = jax.vmap(_w2p)(ws)                       # [K, Co, R]
+    eye = jnp.eye(k, dtype=w2s.dtype)
+    # W_bd[j*R + r, k*Co + o] = w2s[k, o, r] * eye[k, j] — a broadcast
+    # multiply, NOT an einsum: an einsum would lower as one more (spurious)
+    # dot in the HLO and pollute fedcost's GEMM census
+    wbd = eye.T[:, None, :, None] * w2s.transpose(2, 0, 1)[None, :, :, :]
+    return wbd.reshape(k * ci * kh * kw, k * co)
+
+
+def block_diag_unstack(wbd: jnp.ndarray, k: int, kh: int, kw: int,
+                       ci: int, co: int) -> jnp.ndarray:
+    """Block weight [K*R, K*Co] -> stacked kernels [K,kh,kw,Ci,Co]: the
+    bit-exact inverse of :func:`block_diag_weight` (extracts the diagonal
+    blocks; off-diagonal content is discarded by contract)."""
+    r = ci * kh * kw
+    b = wbd.reshape(k, r, k, co)
+    diag = b[jnp.arange(k), :, jnp.arange(k), :]   # [K, R, Co]
+    return jax.vmap(
+        lambda w2: _w2p_inv(w2.T, kh, kw, ci, co))(diag)
+
+
+# -- the lowerings ------------------------------------------------------------
+
+def _patches(xs: jnp.ndarray, kh: int, kw: int, strides: int,
+             padding: str) -> jnp.ndarray:
+    """[K,N,H,W,Ci] -> im2col patches [K,N,Ho,Wo,Ci*kh*kw] (channel-major
+    feature order — the order :func:`_w2p` assumes)."""
+    return jax.vmap(lambda x: lax.conv_general_dilated_patches(
+        x, (kh, kw), (strides, strides), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC")))(xs)
+
+
+def conv_blockdiag(xs: jnp.ndarray, ws: jnp.ndarray, strides: int = 1,
+                   padding: str = "SAME") -> jnp.ndarray:
+    """K clients' convs as ONE block-diagonal GEMM.
+
+    xs: [K, N, H, W, Ci]   (lane-major NHWC)
+    ws: [K, kh, kw, Ci, Co] (stacked per-client HWIO kernels)
+    returns [K, N, Ho, Wo, Co].
+
+    The contraction is written im2col-style — M = batch*pixels streams,
+    N = K*Co output lanes, K_red = K*R reduction lanes — so the fwd dot and
+    both its autodiff transposes (dgrad: N = K*R; wgrad: N = K*Co) keep at
+    least one full MXU dimension at any K*C >= 128.
+    """
+    k, n, _h, _w, ci = xs.shape
+    kh, kw, co = ws.shape[1], ws.shape[2], ws.shape[4]
+    p = _patches(xs, kh, kw, strides, padding)     # [K,N,Ho,Wo,R]
+    ho, wo, r = p.shape[2], p.shape[3], p.shape[4]
+    p2 = p.transpose(1, 2, 3, 0, 4).reshape(n * ho * wo, k * r)
+    wbd = block_diag_weight(ws).astype(xs.dtype)
+    y2 = lax.dot_general(p2, wbd, (((1,), (0,)), ((), ())))
+    return y2.reshape(n, ho, wo, k, co).transpose(3, 0, 1, 2, 4)
+
+
+def conv_grouped(xs: jnp.ndarray, ws: jnp.ndarray, strides: int = 1,
+                 padding: str = "SAME") -> jnp.ndarray:
+    """K clients' convs as ONE grouped convolution
+    (``feature_group_count=K`` over channel-concatenated lanes): useful
+    FLOPs only; the MXU mapping is XLA's choice (H4: the TPU backend
+    expands it block-diagonally itself). Same signature/contract as
+    :func:`conv_blockdiag`."""
+    k, n, h, w, ci = xs.shape
+    kh, kw, co = ws.shape[1], ws.shape[2], ws.shape[4]
+    xg = xs.transpose(1, 2, 3, 0, 4).reshape(n, h, w, k * ci)
+    wg = ws.transpose(1, 2, 3, 0, 4).reshape(kh, kw, ci, k * co)
+    y = lax.conv_general_dilated(
+        xg, wg, (strides, strides), padding, feature_group_count=k,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    ho, wo = y.shape[1], y.shape[2]
+    return y.reshape(n, ho, wo, k, co).transpose(3, 0, 1, 2, 4)
+
+
+def conv_vmap(xs: jnp.ndarray, ws: jnp.ndarray, strides: int = 1,
+              padding: str = "SAME") -> jnp.ndarray:
+    """Per-lane reference lowering (the A/B control): plain vmap of the
+    standard conv — numerics anchor for both packed lowerings and the
+    probe's baseline arm."""
+    return jax.vmap(lambda x, w: lax.conv_general_dilated(
+        x, w, (strides, strides), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC")))(xs, ws)
+
+
+_IMPLS = {"blockdiag": conv_blockdiag, "grouped": conv_grouped,
+          "vmap": conv_vmap}
+
+
+# -- flax modules (auto-named to match the standard models' param paths) -----
+
+class Conv(nn.Module):
+    """Packed drop-in for ``nn.Conv(features, (k,k), strides, padding)`` on
+    lane-major input [K, N, H, W, Ci]. Parameter paths and per-lane shapes
+    match nn.Conv ('kernel' [K,k,k,Ci,Co], optional 'bias' [K,Co], f32) —
+    the leading K axis is the packing axis of stack_variables."""
+
+    features: int
+    kernel_size: int = 3
+    strides: int = 1
+    padding: str = "SAME"
+    use_bias: bool = True
+    impl: str = "blockdiag"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs):
+        k, ci = xs.shape[0], xs.shape[-1]
+        ks = self.kernel_size
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (k, ks, ks, ci, self.features), jnp.float32)
+        xs = xs.astype(self.dtype)
+        y = _IMPLS[self.impl](xs, kernel.astype(self.dtype),
+                              self.strides, self.padding)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (k, self.features), jnp.float32)
+            y = y + bias.astype(self.dtype)[:, None, None, None, :]
+        return y
+
+
+class BatchNorm(nn.Module):
+    """Per-lane BatchNorm on [K, N, ..., C]: stats reduce over each lane's
+    own (N, spatial) axes, parameters/batch_stats are the standard (C,)
+    leaves with a leading K axis. Mirrors flax nn.BatchNorm's numerics
+    (f32 stats as E[x^2]-E[x]^2, momentum running update, rsqrt(var+eps)
+    normalize, cast to ``dtype``)."""
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs):
+        k, c = xs.shape[0], xs.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((k, c), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((k, c), jnp.float32))
+        scale = self.param("scale", nn.initializers.ones, (k, c), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (k, c), jnp.float32)
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            red = tuple(range(1, xs.ndim - 1))
+            xf = xs.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=red)
+            mean2 = jnp.mean(jnp.square(xf), axis=red)
+            var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                ra_mean.value = (self.momentum * ra_mean.value
+                                 + (1.0 - self.momentum) * mean)
+                ra_var.value = (self.momentum * ra_var.value
+                                + (1.0 - self.momentum) * var)
+        shape = (k,) + (1,) * (xs.ndim - 2) + (c,)
+        y = (xs.astype(jnp.float32) - mean.reshape(shape)) \
+            * lax.rsqrt(var.reshape(shape) + self.epsilon)
+        y = y * scale.reshape(shape) + bias.reshape(shape)
+        return y.astype(self.dtype)
+
+
+class Dense(nn.Module):
+    """Packed drop-in for ``nn.Dense(features)`` on [K, N, D]: one batched
+    dot per call ('kernel' [K,D,F], 'bias' [K,F], f32 params)."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs):
+        k, d = xs.shape[0], xs.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (k, d, self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (k, self.features), jnp.float32)
+        y = jnp.einsum("knd,kdf->knf", xs.astype(self.dtype),
+                       kernel.astype(self.dtype))
+        return y + bias.astype(self.dtype)[:, None, :]

@@ -24,16 +24,20 @@ TPU-ism (SURVEY.md §7 hard part (a)) and packing is the TPU-native answer.
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from fedml_tpu.core.tasks import Task
 from fedml_tpu.models import ModelBundle
 from fedml_tpu.parallel.local import (EPOCH_KEY_SALT as _EPOCH_KEY_SALT,
                                       make_batch_sgd_step, make_optimizer)
+
+log = logging.getLogger(__name__)
 
 
 class PackPlan(NamedTuple):
@@ -122,6 +126,32 @@ def plan_packing(counts: np.ndarray, batch_size: int, epochs: int,
                     live, member_pos, member_valid, steps_real)
 
 
+def _member_replay_tables(mask_rows, epochs: int, n_pad: int,
+                          steps_full: int):
+    """The canonical per-member replay tables — EXACTLY
+    make_local_train_fn's per-epoch ``permutation`` over the global n_pad,
+    real-first stable sort, and ``fold_in(ekey, EPOCH_KEY_SALT)`` batch
+    keys. ONE definition shared by the vmapped lane form and the fedpack
+    joint form, so the bit-exact replay contract cannot drift between the
+    two lowerings. Returns ``member_tables(key, row) -> (orders [E,n_pad],
+    bkeys [E,steps_full])``; vmap it over members (and lanes)."""
+
+    def member_tables(key, row):
+        mask_row = mask_rows[row]
+        ekeys = jax.random.split(key, epochs)
+
+        def per_epoch(ek):
+            perm = jax.random.permutation(ek, n_pad)
+            order = perm[jnp.argsort(-mask_row[perm], stable=True)]
+            bkeys = jax.random.split(
+                jax.random.fold_in(ek, _EPOCH_KEY_SALT), steps_full)
+            return order, bkeys
+
+        return jax.vmap(per_epoch)(ekeys)
+
+    return member_tables
+
+
 def make_lane_train(
     bundle: ModelBundle,
     task: Task,
@@ -170,21 +200,9 @@ def make_lane_train(
         opt_state0 = tx_opt.init(params0)
 
         # Exact replay of make_local_train_fn's per-epoch order and batch
-        # keys, per member: perm over the GLOBAL n_pad (uniform shape),
-        # real-first stable sort, bkeys = split(fold_in(ekey, salt), steps).
-        def member_tables(key, row):
-            mask_row = mask_rows[row]
-            ekeys = jax.random.split(key, epochs)
-
-            def per_epoch(ek):
-                perm = jax.random.permutation(ek, n_pad)
-                order = perm[jnp.argsort(-mask_row[perm], stable=True)]
-                bkeys = jax.random.split(
-                    jax.random.fold_in(ek, _EPOCH_KEY_SALT), steps_full)
-                return order, bkeys
-
-            return jax.vmap(per_epoch)(ekeys)   # [E, n_pad], [E, steps_full]
-
+        # keys, per member (shared definition — see _member_replay_tables)
+        member_tables = _member_replay_tables(mask_rows, epochs, n_pad,
+                                              steps_full)
         orders, bkeys = jax.vmap(member_tables)(member_keys, member_row)
 
         def step_fn(carry, xs):
@@ -280,6 +298,269 @@ def make_lane_train(
     return lane_train
 
 
+# --- fedpack: the joint (stacked-lane) execution form -----------------------
+
+_warned_fallback: set = set()
+
+
+def _packed_model_bundle(bundle: ModelBundle, packed_conv: str,
+                         optimizer: str) -> Optional[ModelBundle]:
+    """Resolve the fedpack joint-lane lowering: the packed twin bundle, or
+    None when the per-lane vmap must stay (flag off, model family without a
+    packed variant, dropout models — whose per-lane rng draws the joint
+    apply cannot replay — or an optimizer whose optax state carries leaves
+    without the lane axis, e.g. adam's scalar count, which the per-lane
+    reset logic cannot address)."""
+    if packed_conv in (None, "", "off"):
+        return None
+    reason = None
+    if bundle.packed_variant is None:
+        reason = f"model {bundle.name!r} has no packed conv variant"
+    elif bundle.uses_dropout:
+        reason = f"model {bundle.name!r} uses dropout (per-lane rng streams)"
+    elif optimizer.lower() != "sgd":
+        reason = (f"optimizer {optimizer!r} carries non-lane-shaped state; "
+                  "the joint form supports sgd(+momentum/wd)")
+    if reason is not None:
+        key = (bundle.name, packed_conv, optimizer)
+        if key not in _warned_fallback:
+            _warned_fallback.add(key)
+            log.warning("packed_conv=%r falls back to the per-lane vmap: %s",
+                        packed_conv, reason)
+        return None
+    return bundle.packed_variant(packed_conv)
+
+
+def packed_conv_active(bundle: ModelBundle, packed_conv: str,
+                       optimizer: str = "sgd") -> bool:
+    """Whether :func:`make_lanes_train` will use the fedpack joint form for
+    this (bundle, flag, optimizer) — callers use it to attach fedcost
+    packing hints only to programs that really carry the packed GEMMs."""
+    return _packed_model_bundle(bundle, packed_conv, optimizer) is not None
+
+
+def make_lanes_train(
+    bundle: ModelBundle,
+    task: Task,
+    n_pad: int,
+    *,
+    packed_conv: str = "off",
+    **lane_kwargs,
+) -> Callable:
+    """The all-lanes program both packed round builders share: by default
+    ``vmap`` of :func:`make_lane_train` over the lane axis (XLA lowers the
+    batched-kernel convs to a grouped conv, docs/mfu_experiments.md H4);
+    with ``packed_conv`` on and a capable model, the fedpack JOINT form
+    (:func:`make_packed_lanes_train`) whose convs are ONE block-diagonal/
+    grouped contraction across lanes (ops/packed_conv.py). Same signature
+    and stacked-accumulator return either way."""
+    pb = _packed_model_bundle(bundle, packed_conv,
+                              lane_kwargs.get("optimizer", "sgd"))
+    if pb is None:
+        lane_train = make_lane_train(bundle, task, n_pad, **lane_kwargs)
+        return jax.vmap(lane_train, in_axes=(None,) * 5 + (0,) * 10)
+    return make_packed_lanes_train(bundle, pb, task, n_pad, **lane_kwargs)
+
+
+def make_packed_lanes_train(
+    bundle: ModelBundle,
+    packed_bundle: ModelBundle,
+    task: Task,
+    n_pad: int,
+    *,
+    optimizer: str = "sgd",
+    lr: float = 0.01,
+    momentum: float = 0.0,
+    wd: float = 0.0,
+    epochs: int = 1,
+    batch_size: int = 32,
+    grad_clip: Optional[float] = None,
+    prox_mu: float = 0.0,
+    compute_dtype=None,
+    scan_unroll: int = 1,
+    client_transform: Optional[Callable] = None,
+    reduce_extras: Optional[Callable] = None,
+) -> Callable:
+    """The fedpack JOINT form of ``vmap(lane_train)``: all lanes advance
+    through ONE scan whose per-step model apply sees the stacked lane axis
+    explicitly, so every conv lowers as one client-packed contraction
+    (``packed_bundle``, ops/packed_conv.py) instead of K per-lane
+    partial-lane GEMMs. Everything per-lane — replay tables, reset/freeze
+    masks, weighted accumulation, grad clipping — is computed with an
+    explicit [L] lane vector exactly as the vmap form computes it per lane,
+    so the two forms agree up to GEMM summation order (pinned by
+    tests/test_packed_conv.py).
+
+    Same call signature as the vmapped lane program (variables unstacked;
+    member/plan arrays carrying the leading lane axis) and the same stacked
+    returns, except ``acc_extras`` comes back with a singleton leading axis:
+    the hooks' stacked-clients contract already sums over the lane axis
+    inside one call, and the callers' ``sum(axis=0)`` tail must stay a
+    no-op rather than a reduction over a parameter axis.
+    """
+    del compute_dtype  # callers pre-cast the stacked arrays once
+    from fedml_tpu.ops.packed_conv import stack_variables
+    from fedml_tpu.parallel.local import LocalResult
+
+    tx_opt = make_optimizer(optimizer, lr, momentum, wd)
+    steps_full = n_pad // batch_size
+    bs = batch_size
+    pb = packed_bundle
+
+    def bcast(vec, leaf):
+        """[L] lane vector -> broadcastable against a stacked leaf."""
+        return vec.reshape(vec.shape + (1,) * (leaf.ndim - 1))
+
+    def lanes_train(variables0, x_flat, y_flat, m_flat, mask_rows,
+                    member_row, member_keys, member_w, steps_real,
+                    slot, epoch_a, sie, reset, emit, live):
+        L = slot.shape[0]
+        stack0 = stack_variables(variables0, L)
+        sparams0 = stack0["params"]
+        opt_state0 = tx_opt.init(sparams0)
+
+        # Exact replay of make_local_train_fn's per-epoch order and batch
+        # keys, per (lane, member) — the SAME shared definition the vmap
+        # form uses (_member_replay_tables), so the two lowerings cannot
+        # drift on the replay contract
+        member_tables = _member_replay_tables(mask_rows, epochs, n_pad,
+                                              steps_full)
+        orders, bkeys = jax.vmap(jax.vmap(member_tables))(
+            member_keys, member_row)     # [L,k_max,E,n_pad], [L,k_max,E,S]
+
+        def batch_step_packed(svars, sopt, bx, by, bm, bkey_l):
+            """One joint minibatch step: per-lane losses summed so the grad
+            of the stacked params IS the per-lane grads (the block weight's
+            off-diagonal zeros are structural — ops/packed_conv)."""
+
+            def loss_fn(sp):
+                vars_in = dict(svars)
+                vars_in["params"] = sp
+                logits, new_vars = pb.apply_train(vars_in, bx, bkey_l[0])
+                per_lane = jax.vmap(task.loss)(logits, by, bm)      # [L]
+                if prox_mu:
+                    # per-LANE prox term, folded into per_lane so the
+                    # REPORTED loss matches the vmap form (whose batch_step
+                    # returns loss WITH prox); summing per-lane terms gives
+                    # the same total the grads need (== tree_dot(d, d))
+                    from fedml_tpu.core.pytree import tree_sub
+                    d = tree_sub(sp, sparams0)
+                    prox_l = sum(
+                        jnp.sum(jnp.square(g), axis=tuple(range(1, g.ndim)))
+                        for g in jax.tree.leaves(d))                # [L]
+                    per_lane = per_lane + 0.5 * prox_mu * prox_l
+                return jnp.sum(per_lane), (new_vars, per_lane)
+
+            (_, (new_vars, per_lane)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(svars["params"])
+            if grad_clip:
+                # per-LANE clip (lane == one client's step), the joint form
+                # of the vmap path's per-lane optax.global_norm
+                sq = [jnp.sum(jnp.square(g), axis=tuple(range(1, g.ndim)))
+                      for g in jax.tree.leaves(grads)]
+                gnorm = jnp.sqrt(sum(sq))                            # [L]
+                scale = jnp.minimum(
+                    1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree.map(
+                    lambda g: g * bcast(scale, g).astype(g.dtype), grads)
+            updates, new_opt = tx_opt.update(grads, sopt, svars["params"])
+            out_vars = dict(new_vars)
+            out_vars["params"] = optax.apply_updates(
+                svars["params"], updates)
+            return out_vars, new_opt, per_lane
+
+        def step_fn(carry, xs):
+            (svars, sopt, loss_acc, acc_vars, acc_w, acc_loss, acc_tau,
+             acc_extras) = carry
+            k, e, s, rs, em, lv = xs                    # each [L]
+            svars = jax.tree.map(
+                lambda v, z: jnp.where(bcast(rs, v) > 0, z, v), svars, stack0)
+            sopt = jax.tree.map(
+                lambda v, z: jnp.where(bcast(rs, v) > 0, z, v),
+                sopt, opt_state0)
+            loss_acc = jnp.where(rs > 0, 0.0, loss_acc)
+
+            rows = jnp.take_along_axis(member_row, k[:, None], axis=1)[:, 0]
+            oseg = jax.vmap(
+                lambda o, kk, ee, ss: jax.lax.dynamic_slice(
+                    o, (kk, ee, ss * bs), (1, 1, bs)).reshape(bs)
+            )(orders, k, e, s)                          # [L, bs]
+            flat = rows[:, None] * n_pad + oseg
+            bx = jnp.take(x_flat, flat, axis=0)
+            by = jnp.take(y_flat, flat, axis=0)
+            bm = jnp.take(m_flat, flat, axis=0)
+            bkey_l = jax.vmap(
+                lambda bk, kk, ee, ss: bk[kk, ee, ss])(bkeys, k, e, s)
+
+            new_vars, new_opt, per_lane = batch_step_packed(
+                svars, sopt, bx, by, bm, bkey_l)
+
+            def freeze_if_dead(new, old):
+                return jax.tree.map(
+                    lambda n, o: bcast(lv, n) * n + (1.0 - bcast(lv, n)) * o
+                    if jnp.issubdtype(n.dtype, jnp.floating)
+                    else jnp.where(bcast(lv, n) > 0, n, o),
+                    new, old,
+                )
+
+            new_opt = freeze_if_dead(new_opt, sopt)
+            out_vars = dict(freeze_if_dead(new_vars, svars))
+
+            lastep = (e == epochs - 1).astype(jnp.float32)
+            loss_acc = loss_acc + per_lane * lv * lastep
+
+            w = jnp.take_along_axis(member_w, k[:, None], axis=1)[:, 0] * em
+            sr = jnp.maximum(jnp.take_along_axis(
+                steps_real, k[:, None], axis=1)[:, 0].astype(jnp.float32),
+                1.0)
+            acc_out = out_vars
+            if client_transform is not None:
+                # the hook contract is stacked-clients; the joint form IS
+                # stacked — one call covers every lane
+                acc_out = client_transform(variables0, out_vars)
+            acc_vars = jax.tree.map(
+                lambda a, v: a + bcast(w, v) * v, acc_vars, acc_out)
+            acc_w = acc_w + w
+            acc_loss = acc_loss + w * loss_acc / sr
+            acc_tau = acc_tau + w * epochs * sr
+            if reduce_extras is not None:
+                # w = 0 off-emit, so non-emit lanes contribute exactly
+                # nothing (the same linear-in-w contract the vmap form
+                # relies on); the hook's return is already the lane sum
+                res = LocalResult(out_vars, loss_acc / sr, epochs * sr)
+                ex = reduce_extras(variables0, res, w)
+                acc_extras = jax.tree.map(
+                    lambda a, b: a + b, acc_extras, ex)
+            return (out_vars, new_opt, loss_acc, acc_vars, acc_w, acc_loss,
+                    acc_tau, acc_extras), None
+
+        # zeros DERIVED from inputs (shard_map type consistency, as in the
+        # vmap form)
+        zl = jnp.sum(member_w, axis=1) * 0.0            # [L]
+        acc0 = jax.tree.map(lambda v: v.astype(jnp.float32) * 0.0, stack0)
+        if reduce_extras is not None:
+            ex0 = reduce_extras(
+                variables0,
+                LocalResult(jax.tree.map(lambda v: v * 0.0, stack0),
+                            zl, zl), zl)
+            acc_extras0 = jax.tree.map(lambda e: e * 0.0, ex0)
+        else:
+            acc_extras0 = {}
+        carry0 = (stack0, opt_state0, zl, acc0, zl, zl, zl, acc_extras0)
+        (_, _, _, acc_vars, acc_w, acc_loss, acc_tau, acc_extras), _ = \
+            jax.lax.scan(
+                step_fn, carry0,
+                (slot.T, epoch_a.T, sie.T, reset.T, emit.T, live.T),
+                unroll=max(int(scan_unroll), 1),
+            )
+        # singleton lane axis on the extras: the hook summed lanes already,
+        # and the caller's sum(axis=0) must reduce THIS axis, not a real one
+        acc_extras = jax.tree.map(lambda e: e[None], acc_extras)
+        return acc_vars, acc_w, acc_loss, acc_tau, acc_extras
+
+    return lanes_train
+
+
 def make_packed_cohort_train(
     bundle: ModelBundle,
     task: Task,
@@ -287,6 +568,7 @@ def make_packed_cohort_train(
     shape_key: tuple,
     *,
     compute_dtype=None,
+    packed_conv: str = "off",
     **lane_kwargs,
 ) -> Callable:
     """Build the packed-cohort program (simulation paradigm) for one plan
@@ -295,9 +577,11 @@ def make_packed_cohort_train(
     Returns ``packed_train(variables, tx, ty, tm, sampled_rows, weights_pos,
     rng, plan_arrays) -> (acc_vars, acc_w, acc_loss, acc_tau)`` summed over
     all lanes. Aggregate = ``acc_vars / acc_w`` (elastic-guarded by the
-    caller)."""
-    del shape_key  # lanes are vmapped; shapes come in via the arrays
-    lane_train = make_lane_train(bundle, task, n_pad, **lane_kwargs)
+    caller). ``packed_conv`` selects the fedpack conv lowering for the lane
+    axis (ops/packed_conv.py): 'off' keeps the per-lane vmap."""
+    del shape_key  # lane count and shapes come in via the arrays
+    lanes_fn = make_lanes_train(bundle, task, n_pad,
+                                packed_conv=packed_conv, **lane_kwargs)
 
     def packed_train(variables, tx, ty, tm, sampled_rows, weights_pos, rng,
                      plan_arrays):
@@ -319,12 +603,9 @@ def make_packed_cohort_train(
         member_keys = keys_full[member_pos]
         member_w = weights_pos[member_pos] * member_valid
 
-        lanes = jax.vmap(
-            lane_train,
-            in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
-        )(variables, x_flat, y_flat, m_flat, tm,
-          member_row, member_keys, member_w, steps_real,
-          slot, epoch_a, sie, reset, emit, live)
+        lanes = lanes_fn(variables, x_flat, y_flat, m_flat, tm,
+                         member_row, member_keys, member_w, steps_real,
+                         slot, epoch_a, sie, reset, emit, live)
         acc_vars, acc_w, acc_loss, acc_tau, _extras = lanes
         return (jax.tree.map(lambda a: jnp.sum(a, axis=0), acc_vars),
                 jnp.sum(acc_w), jnp.sum(acc_loss), jnp.sum(acc_tau))
@@ -422,6 +703,7 @@ def make_crosssilo_packed_round(
     axis: str = "clients",
     *,
     compute_dtype=None,
+    packed_conv: str = "off",
     client_transform: Optional[Callable] = None,
     reduce_extras: Optional[Callable] = None,
     server_update: Optional[Callable] = None,
@@ -450,9 +732,13 @@ def make_crosssilo_packed_round(
 
     from fedml_tpu.parallel.crosssilo import apply_server_and_rollback
 
-    lane_train = make_lane_train(bundle, task, n_pad,
-                                 client_transform=client_transform,
-                                 reduce_extras=reduce_extras, **lane_kwargs)
+    # fedpack: the per-device lane block runs the joint stacked-lane form
+    # when packed_conv is on (same psum tail either way — the joint form
+    # returns the same stacked accumulators)
+    lanes_fn = make_lanes_train(bundle, task, n_pad,
+                                packed_conv=packed_conv,
+                                client_transform=client_transform,
+                                reduce_extras=reduce_extras, **lane_kwargs)
 
     def shard_fn(variables, server_state, tx, ty, tm, weights, keys,
                  plan_arrays, rng):
@@ -469,12 +755,10 @@ def make_crosssilo_packed_round(
         member_keys = keys[member_pos]
         member_w = weights[member_pos] * member_valid
 
-        acc_vars, acc_w, acc_loss, _tau, acc_extras = jax.vmap(
-            lane_train,
-            in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0),
-        )(variables, x_flat, y_flat, m_flat, tm,
-          member_pos, member_keys, member_w, steps_real,
-          slot, epoch_a, sie, reset, emit, live)
+        acc_vars, acc_w, acc_loss, _tau, acc_extras = lanes_fn(
+            variables, x_flat, y_flat, m_flat, tm,
+            member_pos, member_keys, member_w, steps_real,
+            slot, epoch_a, sie, reset, emit, live)
 
         acc_vars = jax.tree.map(
             lambda a: jax.lax.psum(jnp.sum(a, axis=0), axis), acc_vars)

@@ -48,6 +48,13 @@ METRICS = {
     # the round program stopped filling the lanes the model shapes allow
     "mfu_vs_lane_ceiling": (
         lambda j: j.get("mfu_vs_lane_ceiling"), "mfu/ceiling"),
+    # fedpack (PR-9 packed_conv A/B block): the packed lowering's static
+    # output-lane ceiling — the lane-ceiling LIFT the client packing buys.
+    # Absent on r01-r08 artifacts (extractor returns None, never a gate
+    # flake on missing keys).
+    "packed_lane_ceiling": (
+        lambda j: (j.get("packed_conv") or {}).get("out_lane_ceiling"),
+        "packed ceiling"),
 }
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")

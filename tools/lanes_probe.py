@@ -18,6 +18,18 @@ Variants isolate where time goes:
 
 Run on the TPU: python tools/lanes_probe.py
 Env: PROBE_ITERS (default 200), PROBE_BATCH (64), PROBE_IMGS_PER_STEP (1).
+
+Packed mode (fedpack, docs/mfu_experiments.md H8): ``--mode packed`` (or
+PROBE_MODE=packed) sweeps the client-packing factor K at the flagship's
+three channel widths and times the three lane-axis conv lowerings of
+ops/packed_conv.py against each other — per-lane ``vmap`` (the packed
+schedule's default), ``blockdiag`` (one im2col block-diagonal GEMM,
+streams K x the useful FLOPs) and ``grouped`` (one feature_group_count=K
+conv). Each row prints the block GEMM's (M, K_red, N), its 128x128 MXU
+tile count, us/iteration and achieved USEFUL GFLOP/s (plus streamed for
+blockdiag — the number the MXU actually executes), for forward and
+forward+grad programs. Same whole-jitted-scan two-point protocol as the
+default mode, so tunnel dispatch cancels.
 """
 
 from __future__ import annotations
@@ -122,6 +134,46 @@ def _conv_variant(mode, xf, w2, h, w):
     )(xp, w2)
 
 
+def packed_main():
+    """The H8 sweep: K x {vmap, blockdiag, grouped} at C = 16/32/64."""
+    from fedml_tpu.ops import packed_conv as pc
+
+    rng = np.random.RandomState(0)
+    results = {}
+    variants = (("vmap", pc.conv_vmap), ("blockdiag", pc.conv_blockdiag),
+                ("grouped", pc.conv_grouped))
+    for (ci, co, h, w) in [(16, 16, 32, 32), (32, 32, 16, 16),
+                           (64, 64, 8, 8)]:
+        for K in (1, 2, 4, 8):
+            tag = f"c{ci}@{h}x{w}-K{K}"
+            xs = jnp.asarray(rng.randn(K, BATCH, h, w, ci), jnp.bfloat16)
+            ws = jnp.asarray(rng.randn(K, 3, 3, ci, co) * 0.1, jnp.bfloat16)
+            m, kr, n = BATCH * h * w, K * 9 * ci, K * co
+            tiles = -(-kr // 128) * (-(-n // 128))
+            useful = 2.0 * K * BATCH * h * w * 9 * ci * co
+            row = {"MKN": [m, kr, n], "mxu_tiles": tiles,
+                   "us": {}, "useful_gflops": {}}
+            for name, fn in variants:
+                us = _time(_scan(lambda a, b, f=fn: f(a, b), xs, ws), xs, ws)
+                row["us"][name] = round(us, 2)
+                row["useful_gflops"][name] = round(useful / us * 1e-3, 1)
+
+                def train(a, b, f=fn):
+                    g = jax.grad(lambda xx: jnp.sum(
+                        (f(xx, b) ** 2).astype(jnp.float32)))(a)
+                    return (g / (jnp.max(jnp.abs(g)) + 1e-3)).astype(a.dtype)
+
+                us_t = _time(_scan(train, xs, ws), xs, ws)
+                row["us"][f"{name}_f+dgrad"] = round(us_t, 2)
+            # streamed rate: what the MXU executes for blockdiag (K x useful)
+            row["streamed_gflops_blockdiag"] = round(
+                useful * K / row["us"]["blockdiag"] * 1e-3, 1)
+            results[tag] = row
+            print(tag, json.dumps(row), flush=True)
+    print(json.dumps({"mode": "packed", "iters": ITERS, "batch": BATCH,
+                      "device": str(jax.devices()[0]), "rows": results}))
+
+
 def main():
     rng = np.random.RandomState(0)
     results = {}
@@ -173,4 +225,12 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--mode", choices=("lanes", "packed"),
+                    default=os.environ.get("PROBE_MODE", "lanes"))
+    if ap.parse_args().mode == "packed":
+        packed_main()
+    else:
+        main()
