@@ -309,8 +309,10 @@ def _bench_crossdevice(tiny: bool):
     on = measure(depth) if depth > 0 else None
     head = on or off
     # fedpulse profiler aggregates of the HEAD arm (the last measured):
-    # per-client EMA train-ms spread, participation fairness, store bytes —
-    # the live-telemetry evidence at the 342k-client operating point
+    # per-client EMA train-ms spread, participation fairness, store bytes,
+    # and the fedsketch percentile lanes (p50/p90/p99 train-ms etc — the
+    # `sketches` block tools/bench_report.py's p99 trajectory columns read)
+    # — the live-telemetry evidence at the 342k-client operating point
     profiler_agg = plane.aggregates() if plane is not None else None
     return {
         "paradigm": "cross-device sampled materialization (virtual client "
@@ -627,7 +629,9 @@ def main():
         # packed program's lifted static lane ceiling, useful-basis MFU
         "packed_conv": packed_conv_ab,
         # fedpulse end-of-run profiler aggregates for the flagship pass
-        # (the cross-device block embeds its own at 342k-client scale)
+        # (the cross-device block embeds its own at 342k-client scale);
+        # carries the fedsketch `sketches` summaries (count + p50/p90/p99
+        # per lane) that bench_report's trajectory columns parse
         "profiler": flagship_profiler,
         "roofline": roofline,
         "registry": registry_snapshot,

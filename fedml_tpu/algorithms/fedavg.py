@@ -655,9 +655,11 @@ class FedAvgAPI:
         (fanned out over the cohort's clients on ``pool``), then ship
         host->device — all while the in-flight round computes. Returns the
         device-resident payload plus stage timings (round_stats)."""
-        from fedml_tpu.obs import tracer_if_enabled
+        from fedml_tpu.obs import tracer_if_sampled
 
-        tr = tracer_if_enabled(0)
+        # the prefetch spans belong to the round they build for, so they
+        # follow that round's head-sampling verdict (same pure function)
+        tr = tracer_if_sampled(0, round_idx)
         t0 = time.perf_counter()
         if tr is None:
             cx, cy, cm, counts = self._host_round_inputs(
@@ -738,9 +740,9 @@ class FedAvgAPI:
         counterpart of the edge paradigm's train leg). With async_rounds
         the span measures DISPATCH (+ trace/compile on a program's first
         call) — the tracer never forces a device sync."""
-        from fedml_tpu.obs import tracer_if_enabled
+        from fedml_tpu.obs import tracer_if_sampled
 
-        tr = tracer_if_enabled(0)
+        tr = tracer_if_sampled(0, round_idx)
         if tr is None:
             return step(*args)
         with tr.span("mesh_step", cat="device",
@@ -770,11 +772,14 @@ class FedAvgAPI:
         The fedpulse plane rides the same wrapper: with ``--pulse_path``
         set, every round feeds the per-client profiler and appends one
         snapshot to the pulse stream — both gates are one global read when
-        off, and neither touches the round's math."""
+        off, and neither touches the round's math. Under
+        ``--trace_sample_rate`` the tracer gate is the deterministic
+        head-sampling verdict for THIS round: a sampled-out round emits no
+        spans, but the pulse/sketch feed below still sees it."""
         from fedml_tpu.obs import (pulse_if_enabled, sample_device_memory,
-                                   tracer_if_enabled)
+                                   tracer_if_sampled)
 
-        tr = tracer_if_enabled(0)
+        tr = tracer_if_sampled(0, round_idx)
         pulse = pulse_if_enabled()
         if tr is None and pulse is None:
             return self._run_round_inner(round_idx)
@@ -894,14 +899,14 @@ class FedAvgAPI:
             row = dict(stages, wait_ms=wait_ms, round=round_idx,
                        compute_ms=(time.perf_counter() - t0) * 1e3)
             self._stage_rows.append(row)
-            from fedml_tpu.obs import default_registry, tracer_if_enabled
+            from fedml_tpu.obs import default_registry, tracer_if_sampled
 
             # the registry's stage-row record mirrors _stage_rows (the
             # round_stats view) so registry readers (MetricsLogger,
             # tests) see the same numbers the summary reports; the trace
             # analyzer gets its copy via the host_stages counter below
             default_registry().append_row("stage", row)
-            tr = tracer_if_enabled(0)
+            tr = tracer_if_sampled(0, round_idx)
             if tr is not None:
                 tr.counter("host_stages", {
                     k: row[k] for k in
@@ -1369,8 +1374,13 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         range, plus ``blk`` amortized ``mesh_round`` child spans (each
         dur/blk, evenly placed) so per-round views of the timeline still
         decompose — amortized attribution, flagged as such, because the scan
-        gives the tracer no real per-round boundary to observe."""
-        from fedml_tpu.obs import timed_build, tracer_if_enabled
+        gives the tracer no real per-round boundary to observe. Under
+        ``--trace_sample_rate`` the sampling unit is the whole BLOCK, keyed
+        by its starting round (the block is one program — per-round gating
+        inside it would tear the amortized children from their parent): a
+        sampled-out block emits nothing, so span volume stays bounded on
+        the superstep path too."""
+        from fedml_tpu.obs import timed_build, tracer_if_sampled
         from fedml_tpu.parallel.mesh import shard_client_batch
 
         pm = self._packed_mesh
@@ -1386,7 +1396,7 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         step_args = (self.variables, self.server_state, *pm["data"], w_dev,
                      jnp.asarray(pm["perm"], jnp.int32), rks,
                      pm["plan_arrays"])
-        tr = tracer_if_enabled(0)
+        tr = tracer_if_sampled(0, start)
         if tr is None:
             out = fns[blk](*step_args)
         else:

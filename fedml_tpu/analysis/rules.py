@@ -373,8 +373,9 @@ def check_config_flag_drift(
 #: wraps _run_round_inner; run_superstep is the reserved name for a future
 #: block-granular public entry)
 _TRACED_ENTRY_POINTS = {"run_round", "run_superstep"}
-#: calls that prove a method opens the trace gate itself
-_TRACE_GATES = {"tracer_if_enabled", "get_tracer"}
+#: calls that prove a method opens the trace gate itself (the head-sampled
+#: gate counts: sampling is the gate's fedsketch form, not a bypass)
+_TRACE_GATES = {"tracer_if_enabled", "tracer_if_sampled", "get_tracer"}
 #: span-opening attribute calls on a tracer
 _SPAN_OPENERS = {"span", "begin_span", "emit_complete"}
 

@@ -231,6 +231,18 @@ class FedConfig:
     # ring-buffer bound per rank: oldest events fall off instead of
     # growing the heap on a weeks-long federation
     trace_buffer_events: int = 65536
+    # fedsketch head-based span sampling (obs/tracer.span_sampled): keep
+    # only this fraction of the ROUND span trees — the keep/drop verdict
+    # is a pure hash of (seed, round), so every rank/host/re-run samples
+    # the SAME rounds and the trace stays a consistent subset. Sampled-out
+    # rounds still feed counters, pulse snapshots and the sketch lanes —
+    # percentiles stay exact while span volume is bounded. 1.0 = keep all.
+    trace_sample_rate: float = 1.0
+    # fedsketch relative accuracy for the profiler's distribution lanes
+    # (train-ms / upload-latency / payload-bytes / staleness): a quantile
+    # estimate is within this fraction of the true value. Smaller = more
+    # buckets (memory grows ~1/alpha, still structurally capped).
+    sketch_alpha: float = 0.01
     # fedcost static roofline attribution (obs/cost, DESIGN.md §13): when
     # on, every round program built through obs/compile.timed_build is
     # ALSO lowered to HLO and read back as a per-op GEMM table (conv/dot
@@ -322,6 +334,13 @@ class FedConfig:
         if self.trace_buffer_events < 1:
             raise ValueError(
                 f"trace_buffer_events must be >= 1, got {self.trace_buffer_events}")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(
+                f"trace_sample_rate must be in [0, 1], got "
+                f"{self.trace_sample_rate}")
+        if not 0.0 < self.sketch_alpha < 0.5:
+            raise ValueError(
+                f"sketch_alpha must be in (0, 0.5), got {self.sketch_alpha}")
         if self.pulse_prometheus_dir and not self.pulse_path:
             raise ValueError(
                 "pulse_prometheus_dir requires pulse_path: the Prometheus "
@@ -525,6 +544,16 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--trace_buffer_events", type=int,
                    default=defaults.trace_buffer_events,
                    help="per-rank trace ring-buffer bound (events)")
+    p.add_argument("--trace_sample_rate", type=float,
+                   default=defaults.trace_sample_rate,
+                   help="keep this fraction of round span trees — "
+                        "deterministic head sampling keyed on (seed, "
+                        "round); sampled-out rounds still feed sketches "
+                        "(1.0 = trace every round)")
+    p.add_argument("--sketch_alpha", type=float,
+                   default=defaults.sketch_alpha,
+                   help="fedsketch relative accuracy for the percentile "
+                        "lanes (smaller = more buckets)")
     p.add_argument("--pulse_path", type=str, default=None,
                    help="fedpulse live telemetry: append one atomic JSON "
                         "snapshot per round boundary to this file; tail it "

@@ -316,9 +316,12 @@ class FedAvgEdgeServerManager(ServerManager):
         workers will actually train from (delta uploads reconstruct against
         it — computing it once here keeps sync and reconstruction the same
         bytes by construction instead of re-encoding per upload)."""
-        from fedml_tpu.obs import tracer_if_enabled
+        from fedml_tpu.obs import tracer_if_sampled
 
-        tr = tracer_if_enabled(self.rank)
+        # head sampling: broadcast and _complete_round derive the SAME
+        # verdict for this round from the pure (seed, round) hash, so a
+        # sampled round always closes the keyed span it opened
+        tr = tracer_if_sampled(self.rank, self.round_idx)
         if tr is not None:
             # the server's round span opens at broadcast and closes in
             # _complete_round — a different handler invocation, so it is a
@@ -433,6 +436,15 @@ class FedAvgEdgeServerManager(ServerManager):
             LOG.warning("catch-up send to rejoined worker %d failed (%s)", w, e)
             self._alive[w] = False
 
+    def _observe_stale(self, rounds_behind: int) -> None:
+        """Feed one dropped contribution's rounds-behind to the pulse
+        plane's staleness sketch (no-op while the plane is off)."""
+        from fedml_tpu.obs import pulse_if_enabled
+
+        pulse = pulse_if_enabled()
+        if pulse is not None:
+            pulse.observe_stale(rounds_behind)
+
     def handle_message_receive_model_from_client(self, msg: Message):
         sender = msg.get_sender_id()
         if self._deadline is not None:
@@ -446,13 +458,18 @@ class FedAvgEdgeServerManager(ServerManager):
             tag = msg.get(MSG_ARG_KEY_ROUND)
             if tag is not None and int(tag) != self.round_idx:
                 # late (possibly retransmitted) upload of a round that was
-                # already deadline-closed: stale, never double-aggregated
+                # already deadline-closed: stale, never double-aggregated.
+                # Its rounds-behind lag feeds the staleness sketch lane —
+                # the tail FedBuff's version-lag weighting will read.
                 self.stale_uploads += 1
+                self._observe_stale(self.round_idx - int(tag))
                 return
             gen = msg.get(MSG_ARG_KEY_GEN)
             if gen is not None and int(gen) != self._bcast_gen:
                 self.stale_uploads += 1
-                return   # pre-re-deal upload of the current round
+                # pre-re-deal upload of the CURRENT round: 0 rounds behind
+                self._observe_stale(0)
+                return
         payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
         # what actually rode the wire: the sparse/small delta for delta
         # uploads, the full weights otherwise — the reconstructed tree
@@ -502,11 +519,11 @@ class FedAvgEdgeServerManager(ServerManager):
         self._complete_round()
 
     def _complete_round(self):
-        from fedml_tpu.obs import pulse_if_enabled, tracer_if_enabled
+        from fedml_tpu.obs import pulse_if_enabled, tracer_if_sampled
 
         self._cancel_timer()
         uploads = len(self.aggregator.model_dict)
-        tr = tracer_if_enabled(self.rank)
+        tr = tracer_if_sampled(self.rank, self.round_idx)
         if tr is None:
             global_params = self.aggregator.aggregate()
         else:
@@ -683,9 +700,11 @@ class FedAvgEdgeClientManager(ClientManager):
         if tag is not None:
             self.round_idx = int(tag)
         self._bcast_gen = msg.get(MSG_ARG_KEY_GEN)
-        from fedml_tpu.obs import tracer_if_enabled
+        from fedml_tpu.obs import tracer_if_sampled
 
-        tr = tracer_if_enabled(self.rank)
+        # the worker derives the same (seed, round) head-sampling verdict
+        # as the server: a sampled round's trace carries EVERY rank's spans
+        tr = tracer_if_sampled(self.rank, self.round_idx)
         if tr is None:
             self._do_train_and_send(msg)
         else:

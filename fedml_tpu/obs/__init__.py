@@ -38,6 +38,14 @@ assumes (arXiv:2303.01778):
   health watchdog (NaN/divergent loss, round stall, ``gave_up``/
   ``stale_uploads`` spikes, straggler skew) with an escalate-to-raise
   mode. ``tools/fedtop.py`` tails the stream live.
+- :mod:`fedml_tpu.obs.sketch` (fedsketch) — fixed-memory, mergeable
+  log-bucketed distribution sketches (~1% relative error, exact
+  order-independent merge, compact JSON codec) behind the profiler's
+  train-ms / upload-latency / payload-bytes / staleness percentile lanes;
+  paired with the tracer's deterministic head-based round sampling
+  (``--trace_sample_rate``, a pure function of (seed, round, id)) so
+  thousand-client cohorts keep bounded spans while sampled-out rounds
+  still feed every sketch.
 
 Tracing is OFF by default and enabled per run via ``--trace_dir``
 (core/config.py); the pulse plane likewise via ``--pulse_path``. The
@@ -68,6 +76,7 @@ from fedml_tpu.obs.registry import (
     MetricsRegistry,
     default_registry,
 )
+from fedml_tpu.obs.sketch import Sketch, merge_all
 from fedml_tpu.obs.tracer import (
     Tracer,
     configure,
@@ -76,8 +85,10 @@ from fedml_tpu.obs.tracer import (
     get_tracer,
     reset,
     set_process_index,
+    span_sampled,
     trace_filename,
     tracer_if_enabled,
+    tracer_if_sampled,
     tracing_enabled,
 )
 
@@ -89,6 +100,7 @@ __all__ = [
     "LiveExporter",
     "MetricsRegistry",
     "PulsePlane",
+    "Sketch",
     "Tracer",
     "compile_counters",
     "configure",
@@ -98,6 +110,7 @@ __all__ = [
     "default_registry",
     "enable_cost_attribution",
     "fwd_flops_per_image",
+    "merge_all",
     "peak_flops",
     "reset_cost_tables",
     "flush_all",
@@ -108,8 +121,10 @@ __all__ = [
     "reset",
     "sample_device_memory",
     "set_process_index",
+    "span_sampled",
     "timed_build",
     "trace_filename",
     "tracer_if_enabled",
+    "tracer_if_sampled",
     "tracing_enabled",
 ]

@@ -20,8 +20,21 @@ rule                severity  fires when
                               ``--health_stale_spike`` this round (late
                               retransmits of deadline-closed rounds piling
                               up — the chaos/straggler signature)
-``straggler_skew``  warn      profiler p95/p50 EMA train-ms exceeds
-                              ``--health_skew`` over >= 4 seen clients
+``straggler_skew``  warn      THIS round's train-ms sketch delta has
+                              p99/p50 over ``--health_skew`` (>= 4 seen
+                              clients; the pulse plane feeds the per-round
+                              delta, so a compile-heavy round 0 can never
+                              own a later round's p99; falls back to the
+                              EMA p95/p50 spread when a profile predates
+                              the sketch lanes or the round holds < 32
+                              samples — a smaller tail is rank noise) —
+                              tail ratio, not mean ranking, so one
+                              pathological straggler in a 10k cohort still
+                              fires it
+``profiles_dropped``  warn    the profiler dropped client ids past its
+                              ``max_clients`` cap this round — the store is
+                              silently blind to part of the cohort (raise
+                              the cap or fix the id space)
 ==================  ========  =============================================
 
 Counter rules are DELTA rules: the watchdog tracks the previous round's
@@ -75,6 +88,8 @@ class HealthWatchdog:
         #: bounded event history (a weeks-long run keeps the latest N)
         self.events: deque = deque(maxlen=int(history))
         self._prev_wire: dict = {}
+        #: delta baseline for the profiles_dropped rule
+        self._prev_dropped = 0
 
     def baseline(self, wire: Optional[dict]) -> None:
         """Seed the delta rules with pre-existing cumulative counters.
@@ -125,13 +140,32 @@ class HealthWatchdog:
             if delta >= thresh:
                 add(rule, severity, f"{key} +{delta} this round (total {cur})")
         if self.skew > 0.0 and profile:
-            ema = profile.get("ema_train_ms") or {}
-            p50, p95 = ema.get("p50"), ema.get("p95")
-            if (p50 and p95 and profile.get("clients_seen", 0) >= 4
-                    and p95 / p50 > self.skew):
+            # sketch-first: the per-ROUND distribution's p99/p50 (the pulse
+            # plane feeds this round's sketch delta here) is the skew
+            # signal at cohort scale — a p99 over fewer than ~32 samples
+            # is rank noise, so small rounds defer to the EMA p95/p50
+            # spread, which also covers pre-sketch profiles
+            sk = (profile.get("sketches") or {}).get("train_ms") or {}
+            p50, ptail = sk.get("p50"), sk.get("p99")
+            basis = "sketch p99/p50 train-ms"
+            if not (p50 and ptail) or sk.get("count", 0) < 32:
+                ema = profile.get("ema_train_ms") or {}
+                p50, ptail = ema.get("p50"), ema.get("p95")
+                basis = "p95/p50 EMA train-ms"
+            if (p50 and ptail and profile.get("clients_seen", 0) >= 4
+                    and ptail / p50 > self.skew):
                 add("straggler_skew", "warn",
-                    f"p95/p50 EMA train-ms {p95 / p50:.2f} exceeds "
+                    f"{basis} {ptail / p50:.2f} exceeds "
                     f"health_skew {self.skew:g}")
+        if profile:
+            cur_dropped = int(profile.get("dropped_ids", 0) or 0)
+            delta = cur_dropped - self._prev_dropped
+            self._prev_dropped = max(self._prev_dropped, cur_dropped)
+            if delta > 0:
+                add("profiles_dropped", "warn",
+                    f"profiler dropped {delta} client id(s) past max_clients "
+                    f"this round (total {cur_dropped}) — per-client telemetry "
+                    "is blind to them")
         for ev in events:
             self.events.append(ev)
         worst = max((_SEVERITY[e["severity"]] for e in events),

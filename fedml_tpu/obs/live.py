@@ -12,6 +12,10 @@ one JSON snapshot appended to ``pulse.jsonl``:
 - the :class:`~fedml_tpu.obs.profile.ClientProfiler` aggregates (clients
   seen, participation fairness, EMA train-ms spread, top-k stragglers,
   staleness, measured store bytes),
+- the profiler's fedsketch distribution lanes (train-ms, broadcast→upload
+  latency, payload bytes, rounds-behind staleness) as per-round
+  p50/p90/p99 + count summaries PLUS the mergeable codec, so per-host
+  streams fold into one cross-host distribution after the run,
 - fedcost attribution of the FLOP-dominant program against the measured
   round wall (achieved GFLOP/s, MAC-basis MFU and its share of the lane
   ceiling) when ``--cost_attribution`` is on,
@@ -63,8 +67,19 @@ __all__ = [
 _LANES = ("time", "wire", "chaos", "compile")
 
 #: process-lifetime stats for the conftest session summary (NEVER reset by
-#: configure()/reset() — they describe the session, not one run)
-_SESSION = {"snapshots": 0, "runs": 0, "critical": 0, "last_path": None}
+#: configure()/reset() — they describe the session, not one run).
+#: ``overhead_pct`` is written by the tier-1 overhead-budget pin via
+#: :func:`record_overhead` so the session log carries the measured number.
+_SESSION = {"snapshots": 0, "runs": 0, "critical": 0, "last_path": None,
+            "overhead_pct": None, "overhead_budget_pct": None}
+
+
+def record_overhead(pct: float, budget_pct: float) -> None:
+    """Record the measured full-plane-on vs plane-off wall delta (percent)
+    from the pinned overhead-budget test; conftest prints it as the
+    ``[t1] obs-overhead:`` session line for tools/t1_report.py."""
+    _SESSION["overhead_pct"] = round(float(pct), 2)
+    _SESSION["overhead_budget_pct"] = round(float(budget_pct), 2)
 
 
 def _round_num(v, nd: int = 3):
@@ -125,8 +140,13 @@ class LiveExporter:
         prof = snap.get("profile") or {}
         gauge("clients_seen", prof.get("clients_seen"))
         gauge("profile_store_bytes", prof.get("store_bytes"))
+        gauge("profile_dropped_ids", prof.get("dropped_ids"))
         gauge("participation_gini", (prof.get("participation") or {}).get("gini"))
         gauge("ema_train_ms_p95", (prof.get("ema_train_ms") or {}).get("p95"))
+        for lane, s in (snap.get("sketches") or {}).items():
+            gauge(f"sketch_{lane}_p50", s.get("p50"))
+            gauge(f"sketch_{lane}_p99", s.get("p99"))
+            gauge(f"sketch_{lane}_count", s.get("count"))
         cost = snap.get("cost") or {}
         gauge("mfu_mac", cost.get("mfu_mac"))
         gauge("mfu_vs_lane_ceiling", cost.get("mfu_vs_ceiling"))
@@ -157,6 +177,8 @@ class PulsePlane:
         self._round_clients = 0
         self._peak = None
         self._peak_resolved = False
+        #: previous round-boundary sketch copies, for the per-round deltas
+        self._prev_sketches: dict = {}
 
     # -- feeds ---------------------------------------------------------------
 
@@ -175,6 +197,20 @@ class PulsePlane:
                           else float(upload_bytes) / ids.size)
             self.profiler.observe(ids, round_idx, train_ms=train_ms,
                                   upload_bytes=per_client)
+            # sketch lanes record the UPLOAD-granular values (one sample per
+            # contribution, not per assigned logical client) — and an
+            # accepted upload is 0 rounds behind on the staleness lane
+            self.profiler.observe_wire(upload_ms=train_ms,
+                                       payload_bytes=upload_bytes,
+                                       staleness=0.0)
+
+    def observe_stale(self, rounds_behind: int) -> None:
+        """Stale-contribution feed (the deadline-closed late-upload path):
+        record how many rounds behind the dropped upload was on the
+        ``staleness`` sketch lane — the tail FedBuff's staleness weighting
+        will read; a sync run's lane is all zeros plus these."""
+        if self.profiler is not None:
+            self.profiler.observe_wire(staleness=max(int(rounds_behind), 0))
 
     def on_sim_round(self, api, round_idx: int, loss, round_ms: float):
         """Simulation-paradigm feed from the traced ``run_round`` wrapper:
@@ -241,8 +277,39 @@ class PulsePlane:
         if stage_rows and stage_rows[-1].get("round") == round_idx:
             stage = {k: _round_num(v) for k, v in stage_rows[-1].items()}
 
-        profile = (self.profiler.aggregates(round_idx)
+        profile = (self.profiler.aggregates(round_idx,
+                                            include_sketches=False)
                    if self.profiler is not None else None)
+        # fedsketch block, from ONE locked copy pass: per-lane cumulative
+        # percentile summary, the per-ROUND delta summary (cumulative minus
+        # the previous boundary — exact bucket subtraction, the sketch form
+        # of the watchdog's delta counter rules), and — only when a stream
+        # will actually persist it — the mergeable codec. Sketches are
+        # cumulative, so any snapshot alone carries the run-so-far
+        # distribution and the LAST one is the whole run — trace_report
+        # merges the last snapshot of each per-host stream.
+        sketches = None
+        if self.profiler is not None:
+            copies = self.profiler.sketch_copies()
+            if copies:
+                sketches = {}
+                for lane, cur in copies.items():
+                    prev = self._prev_sketches.get(lane)
+                    delta = cur if prev is None else cur.since(prev)
+                    entry = {**cur.summary(), "round": delta.summary()}
+                    if self.exporter is not None:
+                        entry["enc"] = cur.encode()
+                    sketches[lane] = entry
+                self._prev_sketches = copies
+            if profile is not None and sketches:
+                # the watchdog's skew basis is THIS round's distribution:
+                # the cumulative lane conflates time (a compile-heavy round
+                # 0 would own the p99 for the next ~100 rounds and false-
+                # fire skew on healthy runs). The snapshot's profile block
+                # carries the per-round summaries; the cumulative ones live
+                # at the snapshot top level, never duplicated.
+                profile["sketches"] = {
+                    lane: s["round"] for lane, s in sketches.items()}
 
         events: list = []
         health = None
@@ -270,8 +337,8 @@ class PulsePlane:
                 "source": source, "loss": loss,
                 "round_ms": _round_num(round_ms), "cohort": n_cohort,
                 "rates": rates, "lanes": lanes, "stage": stage,
-                "profile": profile, "cost": self._cost(round_ms),
-                "health": health}
+                "profile": profile, "sketches": sketches,
+                "cost": self._cost(round_ms), "health": health}
         if self.exporter is not None:
             self.exporter.emit(snap)
         if self.watchdog is not None:
@@ -336,7 +403,8 @@ def pulse_enabled() -> bool:
 def configure(path: Optional[str] = None,
               prometheus_dir: Optional[str] = None, *,
               profile_store: Optional[bool] = None,
-              capacity_hint: int = 1024, loss_limit: float = 0.0,
+              capacity_hint: int = 1024, sketch_alpha: float = 0.01,
+              loss_limit: float = 0.0,
               stall_sec: Optional[float] = None, stale_spike: int = 8,
               skew: float = 4.0,
               escalate: bool = False) -> Optional[PulsePlane]:
@@ -352,7 +420,8 @@ def configure(path: Optional[str] = None,
     if not path and not profile_store:
         return None
     exporter = LiveExporter(path, prometheus_dir) if path else None
-    profiler = (ClientProfiler(capacity_hint=capacity_hint)
+    profiler = (ClientProfiler(capacity_hint=capacity_hint,
+                               sketch_alpha=sketch_alpha)
                 if profile_store else None)
     watchdog = HealthWatchdog(loss_limit=loss_limit, stall_sec=stall_sec,
                               stale_spike=stale_spike, skew=skew,
@@ -385,6 +454,7 @@ def configure_from(config) -> bool:
         return False
     configure(path,
               prometheus_dir=getattr(config, "pulse_prometheus_dir", None),
+              sketch_alpha=getattr(config, "sketch_alpha", 0.01),
               loss_limit=getattr(config, "health_loss_limit", 0.0),
               stall_sec=getattr(config, "health_stall_sec", None),
               stale_spike=getattr(config, "health_stale_spike", 8),

@@ -26,6 +26,15 @@ fairness question ("which clients never get sampled?") needs counts at the
   scheduling), :meth:`staleness` (FedBuff weighting),
   :meth:`participation_fairness` (sampling audits), and :meth:`aggregates`
   (the compact round-boundary summary the pulse stream and fedtop render).
+- **sketch lanes (fedsketch)**: alongside the per-client EMAs, four
+  process-cumulative :class:`~fedml_tpu.obs.sketch.Sketch` lanes record
+  the *distributions* the means hide — ``train_ms`` (per-client walls),
+  ``upload_ms`` (broadcast→upload latency per contribution),
+  ``payload_bytes`` (per contribution), and ``staleness`` (rounds-behind
+  per contribution; fed from the stale-upload path today, the lane
+  FedBuff's version lag will write into). Fixed-memory and mergeable
+  across hosts; their measured bytes count into :attr:`nbytes` so the
+  store's bound stays honest.
 
 Thread-safe (the edge server's handler thread and the sim loop may share
 one process-wide profiler); EMA uses a fixed ``ema_alpha`` so a client's
@@ -39,8 +48,13 @@ from typing import Optional
 
 import numpy as np
 
+from fedml_tpu.obs.sketch import Sketch
+
 #: bytes per client slot across the four field arrays (f32 + f64 + 2*i32)
 BYTES_PER_CLIENT = 20
+
+#: the profiler's distribution lanes, in pulse-snapshot render order
+SKETCH_LANES = ("train_ms", "upload_ms", "payload_bytes", "staleness")
 
 
 def _gini(values: np.ndarray) -> float:
@@ -59,13 +73,15 @@ class ClientProfiler:
     """Bounded array-backed per-client profile store (module docstring)."""
 
     def __init__(self, capacity_hint: int = 1024,
-                 max_clients: int = 2_097_152, ema_alpha: float = 0.2):
+                 max_clients: int = 2_097_152, ema_alpha: float = 0.2,
+                 sketch_alpha: float = 0.01):
         if max_clients < 1:
             raise ValueError(f"max_clients must be >= 1, got {max_clients}")
         if not 0.0 < ema_alpha <= 1.0:
             raise ValueError(f"ema_alpha must be in (0, 1], got {ema_alpha}")
         self.max_clients = int(max_clients)
         self.ema_alpha = float(ema_alpha)
+        self.sketch_alpha = float(sketch_alpha)
         self._cap = min(max(int(capacity_hint), 16), self.max_clients)
         self._lock = threading.Lock()
         self._alloc(self._cap)
@@ -75,6 +91,10 @@ class ClientProfiler:
         self.dropped = 0
         #: highest round index ever observed (staleness base)
         self.last_round = -1
+        #: fedsketch distribution lanes (module docstring); cumulative over
+        #: the run, one shared universe so per-host sketches merge exactly
+        self.sketches: dict = {lane: Sketch(alpha=self.sketch_alpha)
+                               for lane in SKETCH_LANES}
 
     def _alloc(self, cap: int) -> None:
         self._ema_train_ms = np.zeros(cap, np.float32)
@@ -105,6 +125,8 @@ class ClientProfiler:
             self._n = 0
             self.dropped = 0
             self.last_round = -1
+            self.sketches = {lane: Sketch(alpha=self.sketch_alpha)
+                             for lane in SKETCH_LANES}
 
     # -- feed ---------------------------------------------------------------
 
@@ -144,16 +166,60 @@ class ClientProfiler:
                 prev = self._ema_train_ms[ids]
                 self._ema_train_ms[ids] = np.where(
                     first, t, (1.0 - a) * prev + a * t)
+                # sketch lane: one sample per participating client (the
+                # amortized sim feed repeats one scalar cohort-wide — the
+                # count= form skips materializing the copies)
+                if np.ndim(t):
+                    self.sketches["train_ms"].add(t)
+                else:
+                    self.sketches["train_ms"].add(t, count=int(ids.size))
             if upload_bytes is not None:
                 self._upload_bytes[ids] += np.asarray(upload_bytes, np.float64)
+
+    def observe_wire(self, *, upload_ms=None, payload_bytes=None,
+                     staleness=None) -> None:
+        """Per-CONTRIBUTION sketch feed (no client attribution): the edge
+        server records each upload's broadcast→upload latency and decoded
+        payload bytes once per upload (not once per assigned logical
+        client), and every contribution's rounds-behind — 0 for an on-time
+        upload, the deadline-closed lag for a stale one. FedBuff-style
+        aggregation will write its version lag into the same ``staleness``
+        lane."""
+        with self._lock:
+            if upload_ms is not None:
+                self.sketches["upload_ms"].add(upload_ms)
+            if payload_bytes is not None:
+                self.sketches["payload_bytes"].add(payload_bytes)
+            if staleness is not None:
+                self.sketches["staleness"].add(staleness)
 
     # -- queries ------------------------------------------------------------
 
     @property
     def nbytes(self) -> int:
-        """Measured store footprint (the bound the tests pin)."""
+        """Measured store footprint (the bound the tests pin) — the flat
+        per-client arrays PLUS the sketch lanes' sparse stores (each
+        structurally capped at its bucket-universe size)."""
         return int(self._ema_train_ms.nbytes + self._upload_bytes.nbytes
-                   + self._participation.nbytes + self._last_seen.nbytes)
+                   + self._participation.nbytes + self._last_seen.nbytes
+                   + sum(sk.nbytes for sk in self.sketches.values()))
+
+    def sketch_summaries(self) -> dict:
+        """Non-empty sketch lanes as compact summaries (count + p50/p90/p99)
+        in lane order — the pulse snapshot / bench-tail block. Locked: a
+        feed thread mutating a lane mid-iteration would otherwise race the
+        quantile walk."""
+        with self._lock:
+            return {lane: self.sketches[lane].summary()
+                    for lane in SKETCH_LANES if self.sketches[lane].n}
+
+    def sketch_copies(self) -> dict:
+        """One locked pass returning copies of the non-empty lanes, so the
+        pulse plane can derive summaries, encodings AND per-round deltas
+        without re-taking the lock per view."""
+        with self._lock:
+            return {lane: self.sketches[lane].copy()
+                    for lane in SKETCH_LANES if self.sketches[lane].n}
 
     @property
     def clients_seen(self) -> int:
@@ -199,10 +265,15 @@ class ClientProfiler:
                 "mean": round(float(part.mean()), 3)}
 
     def aggregates(self, round_idx: Optional[int] = None,
-                   top_k: int = 5) -> dict:
+                   top_k: int = 5, include_sketches: bool = True) -> dict:
         """Compact round-boundary summary for the pulse stream / fedtop:
         counts, participation fairness, EMA train-ms distribution, the
-        ``top_k`` slowest clients, staleness spread, store footprint."""
+        ``top_k`` slowest clients, staleness spread, store footprint, and
+        (by default) the cumulative sketch summaries — the bench-tail
+        block. The pulse plane passes ``include_sketches=False``: it
+        derives both cumulative and per-round views from its own
+        ``sketch_copies()`` pass, so computing them here too would walk
+        every lane's quantiles twice per round."""
         with self._lock:
             n = self._n
             part = self._participation[:n]
@@ -235,4 +306,8 @@ class ClientProfiler:
         st = base - last.astype(np.int64)
         out["staleness"] = {"mean": round(float(st.mean()), 3),
                             "max": int(st.max())}
+        if include_sketches:
+            sketches = self.sketch_summaries()
+            if sketches:
+                out["sketches"] = sketches
         return out

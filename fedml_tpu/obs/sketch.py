@@ -1,0 +1,241 @@
+"""fedsketch: fixed-memory, mergeable log-bucketed distribution sketches.
+
+The pulse plane's EMA/mean lanes answer "how fast on average"; the next
+ROADMAP battles key on the *distribution* — heterogeneity-aware cohort
+scheduling reads the observed client-speed spread (FedML Parrot,
+arXiv:2303.01778), FedBuff weighting reads the staleness tail, and a
+10k-client cohort's health is its p99 train latency, not its mean. Keeping
+raw samples at that scale is exactly the unbounded growth the plane's
+contracts forbid, so this module is the DDSketch/HDR-histogram compromise:
+
+- **log-bucketed**: a value ``v`` lands in bucket ``ceil(log_g(v))`` with
+  ``g = (1+a)/(1-a)``; the bucket's representative ``2*g^i/(g+1)`` is
+  within relative error ``a`` (default 1%) of every value it holds.
+- **fixed memory**: the bucket universe is the CLOSED index range implied
+  by ``[min_value, max_value]`` — values outside clamp to the edge buckets
+  (and non-positive values to a dedicated zero bucket) instead of growing
+  the range. No collapse pass, so the universe never shifts: ~2.1k
+  possible buckets at the defaults, stored sparsely, ``nbytes`` measured.
+- **exact merge**: two sketches with the same ``(alpha, min, max)`` merge
+  by integer bucket-count addition — commutative, associative, and
+  insert-order-independent *by construction* (no collapse means no
+  order-dependent state), which is what lets per-host sketches merge into
+  one cross-host distribution with zero error beyond the bucket width.
+  This is the property DDSketch's collapsing variant gives up; we pin the
+  universe instead so federated merges stay exact.
+- **deterministic**: the bucket map is a pure function of the value (one
+  ``np.log`` + ``ceil`` on float64 — same binary, same buckets), so a
+  sketch-on run stays bit-identical and replays reproduce the sketch.
+- **compact JSON codec**: ``encode()``/``decode()`` round-trip the sparse
+  (index, count) pairs + config; the pulse stream carries it per lane so
+  ``tools/trace_report.py`` can merge per-host streams after the run.
+
+BlazeFL (arXiv:2604.03606) sets the determinism bar the whole plane holds:
+everything here is integer counts over a fixed map — no clocks, no RNG.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+from typing import Iterable, Optional
+
+import numpy as np
+
+__all__ = ["Sketch", "merge_all"]
+
+#: universal defaults shared by every pulse lane (ms, bytes, rounds all fit
+#: [1e-3, 1e15]); one universe means any two default sketches can merge
+DEFAULT_ALPHA = 0.01
+DEFAULT_MIN = 1e-3
+DEFAULT_MAX = 1e15
+
+
+class Sketch:
+    """One mergeable log-bucketed histogram (module docstring)."""
+
+    __slots__ = ("alpha", "min_value", "max_value", "_gamma", "_lg",
+                 "_lo", "_hi", "zero", "n", "_bins")
+
+    def __init__(self, alpha: float = DEFAULT_ALPHA,
+                 min_value: float = DEFAULT_MIN,
+                 max_value: float = DEFAULT_MAX):
+        if not 0.0 < alpha < 0.5:
+            raise ValueError(f"alpha must be in (0, 0.5), got {alpha}")
+        if not 0.0 < min_value < max_value:
+            raise ValueError(
+                f"need 0 < min_value < max_value, got {min_value}, {max_value}")
+        self.alpha = float(alpha)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+        self._gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._lg = math.log(self._gamma)
+        self._lo = int(math.ceil(math.log(self.min_value) / self._lg))
+        self._hi = int(math.ceil(math.log(self.max_value) / self._lg))
+        #: non-positive (and NaN/-inf) observations: exact count, value 0
+        self.zero = 0
+        #: total observations ever added (zero bucket included)
+        self.n = 0
+        self._bins: dict = {}
+
+    # -- feed ----------------------------------------------------------------
+
+    def add(self, values, count: Optional[int] = None) -> None:
+        """Record ``values`` (scalar or array). ``count`` repeats a SCALAR
+        value that many times (the cohort-amortized feed) without
+        materializing the copies."""
+        v = np.asarray(values, np.float64).ravel()
+        if v.size == 0:
+            return
+        if count is not None:
+            if v.size != 1:
+                raise ValueError("count= only repeats a scalar value")
+            reps = int(count)
+            if reps <= 0:
+                return
+        else:
+            reps = 1
+        pos = (v > 0.0) & np.isfinite(v)
+        n_inf = int(np.isposinf(v).sum())
+        n_zero = int(v.size) - int(pos.sum()) - n_inf
+        if n_zero:
+            self.zero += n_zero * reps
+        if n_inf:
+            self._bins[self._hi] = self._bins.get(self._hi, 0) + n_inf * reps
+        vp = v[pos]
+        if vp.size:
+            idx = np.ceil(np.log(vp) / self._lg).astype(np.int64)
+            np.clip(idx, self._lo, self._hi, out=idx)
+            uniq, cnt = np.unique(idx, return_counts=True)
+            bins = self._bins
+            for i, c in zip(uniq.tolist(), cnt.tolist()):
+                bins[i] = bins.get(i, 0) + c * reps
+        self.n += int(v.size) * reps
+
+    # -- queries -------------------------------------------------------------
+
+    def _bucket_value(self, idx: int) -> float:
+        # representative of (g^(i-1), g^i]: the midpoint-in-log 2g^i/(g+1),
+        # within alpha of everything the bucket holds
+        return 2.0 * math.exp(idx * self._lg) / (self._gamma + 1.0)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate q-quantile (relative error <= alpha inside the
+        universe); None on an empty sketch."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        if self.n == 0:
+            return None
+        target = q * (self.n - 1)
+        cum = self.zero
+        if cum > target:
+            return 0.0
+        for idx, c in sorted(self._bins.items()):
+            cum += c
+            if cum > target:
+                return self._bucket_value(idx)
+        return self._bucket_value(self._hi)  # pragma: no cover - fp slack
+
+    def summary(self, nd: int = 3) -> dict:
+        """The compact per-round pulse summary: count + p50/p90/p99."""
+        out = {"count": int(self.n)}
+        if self.n:
+            for name, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+                out[name] = round(float(self.quantile(q)), nd)
+        return out
+
+    @property
+    def max_bins(self) -> int:
+        """Structural memory bound: the bucket universe size (+ zero)."""
+        return self._hi - self._lo + 2
+
+    @property
+    def nbytes(self) -> int:
+        """Measured sparse-store footprint (dict + int entries)."""
+        b = self._bins
+        return (sys.getsizeof(b)
+                + sum(sys.getsizeof(k) + sys.getsizeof(v)
+                      for k, v in b.items()))
+
+    # -- merge & codec -------------------------------------------------------
+
+    def _compatible(self, other: "Sketch") -> bool:
+        return (self.alpha == other.alpha
+                and self.min_value == other.min_value
+                and self.max_value == other.max_value)
+
+    def merge(self, other: "Sketch") -> "Sketch":
+        """In-place exact merge (integer bucket addition); returns self.
+        Raises on mismatched universes — a silent lossy re-map would break
+        the order-independence contract."""
+        if not self._compatible(other):
+            raise ValueError(
+                f"cannot merge sketches with different universes: "
+                f"(a={self.alpha}, {self.min_value}..{self.max_value}) vs "
+                f"(a={other.alpha}, {other.min_value}..{other.max_value})")
+        self.zero += other.zero
+        self.n += other.n
+        bins = self._bins
+        for i, c in other._bins.items():
+            bins[i] = bins.get(i, 0) + c
+        return self
+
+    def copy(self) -> "Sketch":
+        out = Sketch(self.alpha, self.min_value, self.max_value)
+        out.zero = self.zero
+        out.n = self.n
+        out._bins = dict(self._bins)
+        return out
+
+    def since(self, prev: "Sketch") -> "Sketch":
+        """Exact interval delta of a cumulative sketch: the distribution of
+        everything observed AFTER ``prev`` was snapshotted (bucket-wise
+        subtraction — the sketch analogue of the watchdog's delta counter
+        rules). ``prev`` must be an earlier snapshot of the same stream;
+        counts never go negative (clamped defensively)."""
+        if not self._compatible(prev):
+            raise ValueError(
+                "since() needs an earlier snapshot of the same universe")
+        out = Sketch(self.alpha, self.min_value, self.max_value)
+        out.zero = max(self.zero - prev.zero, 0)
+        out.n = max(self.n - prev.n, 0)
+        out._bins = {i: c - prev._bins.get(i, 0)
+                     for i, c in self._bins.items()
+                     if c - prev._bins.get(i, 0) > 0}
+        return out
+
+    def encode(self) -> dict:
+        """Compact JSON-safe codec: config + zero count + sorted sparse
+        (index, count) pairs. Sorting makes equal sketches encode to equal
+        bytes — the golden-stability property the tests pin."""
+        return {"v": 1, "a": self.alpha, "min": self.min_value,
+                "max": self.max_value, "z": int(self.zero), "n": int(self.n),
+                "b": [[int(i), int(c)] for i, c in sorted(self._bins.items())]}
+
+    @classmethod
+    def decode(cls, obj: dict) -> "Sketch":
+        if not isinstance(obj, dict) or obj.get("v") != 1:
+            raise ValueError(f"not a v1 sketch encoding: {obj!r}")
+        out = cls(float(obj["a"]), float(obj["min"]), float(obj["max"]))
+        out.zero = int(obj["z"])
+        out.n = int(obj["n"])
+        out._bins = {int(i): int(c) for i, c in obj.get("b", [])}
+        return out
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Sketch) and self._compatible(other)
+                and self.zero == other.zero and self.n == other.n
+                and self._bins == other._bins)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Sketch(alpha={self.alpha}, n={self.n}, "
+                f"buckets={len(self._bins)})")
+
+
+def merge_all(sketches: Iterable[Sketch]) -> Optional[Sketch]:
+    """Merge any number of compatible sketches into a fresh one (None when
+    the iterable is empty) — the cross-host fold trace_report runs."""
+    out = None
+    for sk in sketches:
+        out = sk.copy() if out is None else out.merge(sk)
+    return out

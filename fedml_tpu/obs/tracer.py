@@ -21,6 +21,18 @@ the recv span that handled it BY MESSAGE ID, through every transport and
 through the reliable/chaos middleware — a retransmit storm collapses onto
 the one logical edge it belongs to.
 
+Deterministic head-based sampling (fedsketch): at thousand-client cohorts
+the full-fidelity per-round span volume is the plane's scaling wall, so
+``--trace_sample_rate r`` keeps only a reproducible fraction of the ROUND
+trees. The keep/drop verdict is :func:`span_sampled` — a pure splitmix64
+hash of ``(trace seed, round, client/rank id)``, no RNG state, no clocks —
+so every rank (and every host, and every re-run) derives the SAME verdict
+for a round: a sampled trace is a consistent subset (no rounds missing
+ranks), and two runs with the same seed sample the same rounds. Round-level
+call sites gate through :func:`tracer_if_sampled`; sampled-out rounds skip
+span emission entirely while counters, pulse snapshots and sketch lanes
+still see every round — percentiles stay exact while spans stay bounded.
+
 Overhead contract (pinned by tests/test_trace.py):
 
 - disabled (the default): ``tracer_if_enabled(rank)`` is a module-global
@@ -307,6 +319,45 @@ _BUFFER = 65536
 _TRACERS: dict[int, Tracer] = {}
 _TRACE_ID: Optional[str] = None
 _JAX_BRIDGE = False
+#: head-based span sampling: keep fraction + the seed the pure verdict
+#: hashes (defaults = keep everything, the pre-fedsketch behavior)
+_SAMPLE_RATE = 1.0
+_SAMPLE_SEED = 0
+
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """One splitmix64 mixing step — the standard 64-bit finalizer; full
+    avalanche, so adjacent (seed, round, id) triples decorrelate."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return (x ^ (x >> 31)) & _M64
+
+
+def span_sampled(round_idx: int, entity: int = 0, *,
+                 rate: Optional[float] = None,
+                 seed: Optional[int] = None) -> bool:
+    """The head-based keep/drop verdict: a pure function of
+    ``(trace seed, round, entity)`` — deterministic across ranks, hosts,
+    threads and re-runs; no state is consulted or advanced.
+
+    ``entity`` defaults to 0 so every rank of a federation derives ONE
+    shared verdict per round (a sampled trace never has rounds missing
+    ranks); pass a client/rank id for finer per-entity span families (the
+    FedBuff per-client spans to come)."""
+    r = _SAMPLE_RATE if rate is None else float(rate)
+    if r >= 1.0:
+        return True
+    if r <= 0.0:
+        return False
+    s = _SAMPLE_SEED if seed is None else int(seed)
+    h = _splitmix64(s & _M64)
+    h = _splitmix64(h ^ (int(round_idx) & _M64))
+    h = _splitmix64(h ^ (int(entity) & _M64))
+    # top 53 bits -> uniform [0, 1): exact on every platform's float64
+    return (h >> 11) * (2.0 ** -53) < r
 #: this host's process index under jax.distributed; None = resolve lazily
 #: from jax.process_index() at first tracer creation
 _PROCESS: Optional[int] = None
@@ -340,16 +391,25 @@ def _process_index() -> int:
 
 
 def configure(trace_dir: Optional[str], buffer_events: int = 65536,
-              jax_bridge: bool = False, trace_id: Optional[str] = None) -> None:
+              jax_bridge: bool = False, trace_id: Optional[str] = None,
+              sample_rate: float = 1.0, sample_seed: int = 0) -> None:
     """Enable tracing into ``trace_dir`` (None disables). Existing
-    per-rank tracers are kept so an in-flight run reconfiguring is safe."""
+    per-rank tracers are kept so an in-flight run reconfiguring is safe.
+    ``sample_rate``/``sample_seed`` drive :func:`span_sampled`'s
+    deterministic head-based round sampling (1.0 = keep every round)."""
     global _ENABLED, _TRACE_DIR, _BUFFER, _TRACE_ID, _JAX_BRIDGE
+    global _SAMPLE_RATE, _SAMPLE_SEED
+    if not 0.0 <= sample_rate <= 1.0:
+        raise ValueError(
+            f"sample_rate must be in [0, 1], got {sample_rate}")
     with _lock:
         _TRACE_DIR = trace_dir
         _ENABLED = bool(trace_dir)
         _BUFFER = max(int(buffer_events), 1)
         _JAX_BRIDGE = bool(jax_bridge)
         _TRACE_ID = trace_id or uuid.uuid4().hex[:16]
+        _SAMPLE_RATE = float(sample_rate)
+        _SAMPLE_SEED = int(sample_seed)
         if _ENABLED:
             os.makedirs(trace_dir, exist_ok=True)
 
@@ -381,7 +441,11 @@ def configure_from(config) -> bool:
         return False
     configure(trace_dir,
               buffer_events=getattr(config, "trace_buffer_events", 65536),
-              jax_bridge=bool(getattr(config, "profile_dir", None)))
+              jax_bridge=bool(getattr(config, "profile_dir", None)),
+              # the run seed doubles as the trace seed: re-running the same
+              # config samples the same rounds (BlazeFL-grade replays)
+              sample_rate=getattr(config, "trace_sample_rate", 1.0),
+              sample_seed=getattr(config, "seed", 0))
     return True
 
 
@@ -415,6 +479,20 @@ def tracer_if_enabled(rank: int = 0) -> Optional[Tracer]:
     """Hot-path gate: ``None`` while tracing is off — one global read, no
     allocation — else the rank's tracer."""
     if not _ENABLED:
+        return None
+    return get_tracer(rank)
+
+
+def tracer_if_sampled(rank: int = 0, round_idx: int = 0) -> Optional[Tracer]:
+    """Round-level hot-path gate: ``None`` while tracing is off (one global
+    read, nothing allocated — same contract as :func:`tracer_if_enabled`)
+    OR while this round is head-sampled out; else the rank's tracer. The
+    per-round span call sites (round/mesh_step/prefetch/edge train) gate
+    through this so a ``--trace_sample_rate`` run emits a bounded,
+    reproducible span subset."""
+    if not _ENABLED:
+        return None
+    if _SAMPLE_RATE < 1.0 and not span_sampled(round_idx):
         return None
     return get_tracer(rank)
 
@@ -454,11 +532,14 @@ def reset() -> None:
     tears down the fedpulse plane — a plane leaked across tests would feed
     every later run_round in the process."""
     global _ENABLED, _TRACE_DIR, _TRACE_ID, _PROCESS
+    global _SAMPLE_RATE, _SAMPLE_SEED
     with _lock:
         _ENABLED = False
         _TRACE_DIR = None
         _TRACE_ID = None
         _PROCESS = None
+        _SAMPLE_RATE = 1.0
+        _SAMPLE_SEED = 0
         _TRACERS.clear()
     from fedml_tpu.obs import live as _live
 

@@ -112,5 +112,13 @@ def pytest_sessionfinish(session, exitstatus):
             emit(f"[t1] pulse: {st['snapshots']} snapshot(s) over "
                  f"{st['runs']} run(s), {st['critical']} critical health "
                  f"event(s), last {st['last_path']}")
+        # fedsketch overhead budget: the pinned 10k-cohort plane-on/off
+        # test records its measured wall delta via live.record_overhead;
+        # surfacing it per session makes an overhead creep visible in the
+        # tier-1 log before it ever trips the 5% pin
+        if st.get("overhead_pct") is not None:
+            emit(f"[t1] obs-overhead: {st['overhead_pct']:+.2f}% wall, "
+                 f"full plane on vs off (budget "
+                 f"{st['overhead_budget_pct']:g}%)")
     except Exception:
         pass

@@ -152,3 +152,72 @@ def test_missing_metric_never_pairs_across_gaps():
     rows = br.load_series(COMMITTED)
     regs = br.detect_regressions(rows, threshold=0.10)
     assert regs == []
+
+
+# -- fedsketch trajectory columns (ISSUE 10 satellite) ----------------------
+
+def test_sketch_columns_render_dash_on_presketch_artifacts(capsys):
+    """r01-r05 predate the profiler sketch block: the p99 train-ms and
+    staleness columns render '-' (missing-key tolerant) and the committed
+    series still gates clean."""
+    rc = br.main(COMMITTED)
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "p99 train-ms" in out.out and "p99 staleness" in out.out
+    header, *rows = [l for l in out.out.splitlines() if l.strip()]
+    for row in rows:
+        if row.lstrip().startswith("r0"):
+            assert row.rstrip().endswith("-")      # staleness column empty
+
+
+def test_sketch_columns_parse_and_never_gate(tmp_path, capsys):
+    """Artifacts that DO carry sketch summaries populate the columns; a
+    worsening (rising) p99 is rendered but never a regression — the
+    latency/staleness tails are lower-is-better, display-only."""
+    def art(n, p99_train, p99_stale):
+        bench = {"metric": "x", "value": 100.0,
+                 "profiler": {"sketches": {
+                     "train_ms": {"count": 10, "p50": 1.0, "p90": 2.0,
+                                  "p99": p99_train},
+                     "staleness": {"count": 10, "p50": 0.0, "p90": 1.0,
+                                   "p99": p99_stale}}}}
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps({"n": n, "tail": json.dumps(bench)}))
+        return str(p)
+
+    paths = [art(1, 5.0, 0.0), art(2, 500.0, 9.0)]   # 100x worse tails
+    rows = br.load_series(paths)
+    assert rows[0]["p99_train_ms"] == pytest.approx(5.0)
+    assert rows[1]["p99_train_ms"] == pytest.approx(500.0)
+    assert rows[1]["p99_staleness"] == pytest.approx(9.0)
+    assert br.detect_regressions(rows, threshold=0.10) == []
+    rc = br.main(paths)
+    out = capsys.readouterr()
+    assert rc == 0 and "500" in out.out
+
+
+# -- t1_report: the [t1] obs-overhead session line (ISSUE 10 satellite) -----
+
+def test_t1_report_parses_obs_overhead_line(tmp_path, capsys):
+    t1 = importlib.util.spec_from_file_location(
+        "t1_report", os.path.join(REPO, "tools", "t1_report.py"))
+    mod = importlib.util.module_from_spec(t1)
+    t1.loader.exec_module(mod)
+    log = (
+        "....s..x [ 12%]\n"
+        "========= 8 passed in 3.21s =========\n"
+        "[t1] compile-cache: 4 hit(s) / 1 miss(es) this session, "
+        "9 persistent entries in .jax_cache\n"
+        "[t1] obs-overhead: +1.92% wall, full plane on vs off (budget 5%)\n")
+    p = tmp_path / "t1.log"
+    p.write_text(log)
+    rep = mod.parse_log(log)
+    assert rep["obs_overhead"] == \
+        "+1.92% wall, full plane on vs off (budget 5%)"
+    assert mod.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "obs-overhead: +1.92% wall" in out
+    # logs predating the line parse to None and render without it
+    rep2 = mod.parse_log("....\n========= 4 passed in 1s =========\n")
+    assert rep2["obs_overhead"] is None
+    assert "obs-overhead" not in mod.format_report(rep2)

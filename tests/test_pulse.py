@@ -231,6 +231,21 @@ def test_pulse_sim_run_bit_identical(tmp_path):
     assert last["profile"]["clients_seen"] == 4
     assert last["profile"]["participation"]["gini"] == 0.0
     assert last["health"]["state"] == "ok"
+    # fedsketch lanes: the sim feed amortizes the round wall per client, so
+    # the cumulative train_ms sketch holds cohort x rounds samples, carries
+    # ordered percentiles, the per-ROUND delta summary AND the mergeable
+    # codec
+    sk = last["sketches"]["train_ms"]
+    assert sk["count"] == 4 * 3
+    assert 0 < sk["p50"] <= sk["p90"] <= sk["p99"]
+    from fedml_tpu.obs.sketch import Sketch
+
+    assert Sketch.decode(sk["enc"]).n == sk["count"]
+    # the snapshot's profile block carries THIS round's delta (the
+    # watchdog's skew basis — one cohort's worth of samples), never a
+    # duplicate of the cumulative summary
+    assert sk["round"]["count"] == 4
+    assert last["profile"]["sketches"]["train_ms"] == sk["round"]
     # the plane was torn down with the run's configure_from semantics:
     # a later config without pulse_path disables it
     _sim_run(None)
@@ -309,6 +324,16 @@ def test_pulse_grpc_edge_4_ranks_bit_identical(tmp_path):
     assert last["profile"]["ema_train_ms"]["p95"] > 0
     assert last["lanes"]["wire"]["uploads"] == 3      # one per worker
     assert last["lanes"]["wire"]["workers_alive"] == 3
+    # fedsketch wire lanes are UPLOAD-granular (3 workers x 2 rounds), and
+    # a clean synchronous run's staleness lane is all zeros — the baseline
+    # FedBuff's version lag will move
+    sk = last["sketches"]
+    assert sk["upload_ms"]["count"] == 6 and sk["upload_ms"]["p99"] > 0
+    assert sk["payload_bytes"]["count"] == 6 and sk["payload_bytes"]["p50"] > 0
+    assert sk["staleness"]["count"] == 6
+    assert sk["staleness"]["p99"] == 0.0
+    # train_ms lane is CLIENT-granular (4 logical clients x 2 rounds)
+    assert sk["train_ms"]["count"] == 8
 
 
 # -- seeded chaos: stream survives faults; escalate kills loudly ------------
@@ -453,6 +478,18 @@ def test_pulse_stale_spike_flagged_at_round_boundary(tmp_path):
              if e["rule"] == "stale_spike"]
     assert spike and spike[0]["severity"] == "warn"
     assert snaps[1]["health"]["state"] == "warn"
+    # the stale contribution ALSO fed the staleness sketch lane with its
+    # rounds-behind lag (1): on-time uploads are the zeros, the late one
+    # is the tail
+    st = snaps[1]["sketches"]["staleness"]
+    assert st["count"] == 3                  # 2 accepted + 1 stale
+    assert st["p50"] == 0.0
+    # at n=3 the p99 rank still sits in the zero bucket; the lag-1
+    # contribution is the distribution's max
+    from fedml_tpu.obs.sketch import Sketch
+
+    tail = Sketch.decode(st["enc"]).quantile(1.0)
+    assert 0.9 < tail < 1.1
 
 
 def test_pulse_gossip_round_profiles_every_node(tmp_path):
@@ -575,3 +612,205 @@ def test_trace_report_joins_pulse_beside_trace(tmp_path, capsys):
     assert "health: warn" in out
     rep = tr.analyze(tr.load_trace_dir(str(d)))
     assert "client_profiles" not in rep      # analyze() itself is untouched
+
+
+# -- fedsketch: watchdog re-key + dropped-id accounting (ISSUE 10) ----------
+
+def test_watchdog_skew_re_keys_on_sketch_tail():
+    """straggler_skew reads the train-ms SKETCH's p99/p50 tail ratio first
+    (mean-free: one pathological straggler in a big cohort still moves the
+    p99), falling back to the EMA spread only for pre-sketch profiles."""
+    wd = HealthWatchdog(skew=3.0)
+    prof = {"clients_seen": 100,
+            "ema_train_ms": {"p50": 10.0, "p95": 11.0},   # EMA says calm...
+            "sketches": {"train_ms": {"count": 100, "p50": 10.0,
+                                      "p90": 12.0, "p99": 40.0}}}
+    ev = wd.check_round(0, profile=prof)
+    assert [e["rule"] for e in ev] == ["straggler_skew"]
+    assert "sketch p99/p50" in ev[0]["detail"]
+    # a calm sketch tail does NOT fire even if the EMA spread would
+    calm = {"clients_seen": 100,
+            "ema_train_ms": {"p50": 10.0, "p95": 100.0},
+            "sketches": {"train_ms": {"count": 100, "p50": 10.0,
+                                      "p90": 11.0, "p99": 12.0}}}
+    assert HealthWatchdog(skew=3.0).check_round(0, profile=calm) == []
+    # fallback: no sketches key -> the EMA p95/p50 rule still works
+    legacy = {"clients_seen": 8, "ema_train_ms": {"p50": 10.0, "p95": 40.0}}
+    ev = HealthWatchdog(skew=3.0).check_round(0, profile=legacy)
+    assert [e["rule"] for e in ev] == ["straggler_skew"]
+    assert "EMA" in ev[0]["detail"]
+
+
+def test_watchdog_profiles_dropped_is_a_delta_warn_rule():
+    wd = HealthWatchdog()
+    assert wd.check_round(0, profile={"clients_seen": 1,
+                                      "dropped_ids": 0}) == []
+    ev = wd.check_round(1, profile={"clients_seen": 1, "dropped_ids": 5})
+    assert [e["rule"] for e in ev] == ["profiles_dropped"]
+    assert ev[0]["severity"] == "warn" and "+" not in ev[0]["detail"][:1]
+    assert "5 client id(s)" in ev[0]["detail"]
+    # delta rule: an unchanged cumulative total does not re-fire
+    assert wd.check_round(2, profile={"clients_seen": 1,
+                                      "dropped_ids": 5}) == []
+    ev = wd.check_round(3, profile={"clients_seen": 1, "dropped_ids": 7})
+    assert [e["rule"] for e in ev] == ["profiles_dropped"]
+    assert "2 client id(s)" in ev[0]["detail"]
+
+
+def test_profiles_dropped_surfaces_in_snapshot_end_to_end(tmp_path):
+    """ISSUE 10 satellite: ids past max_clients were dropped into a counter
+    nobody read — now the pulse snapshot carries the count AND the watchdog
+    warns the round it grows."""
+    path = str(tmp_path / "pulse.jsonl")
+    plane = pulse_live.PulsePlane(
+        exporter=pulse_live.LiveExporter(path),
+        profiler=ClientProfiler(capacity_hint=4, max_clients=8),
+        watchdog=HealthWatchdog())
+    snap = plane.on_round(0, source="t", cohort_ids=[1, 2, 3],
+                          train_ms_per_client=5.0)
+    assert snap["profile"]["dropped_ids"] == 0
+    assert snap["health"]["state"] == "ok"
+    # two ids beyond the cap: counted + warned, never indexed
+    snap = plane.on_round(1, source="t", cohort_ids=[2, 100, 200],
+                          train_ms_per_client=5.0)
+    assert snap["profile"]["dropped_ids"] == 2
+    rules = [e["rule"] for e in snap["health"]["events"]]
+    assert rules == ["profiles_dropped"]
+    assert snap["health"]["state"] == "warn"
+    # stable cap count -> no re-fire next round
+    snap = plane.on_round(2, source="t", cohort_ids=[1],
+                          train_ms_per_client=5.0)
+    assert [e["rule"] for e in snap["health"]["events"]] == []
+    plane.close()
+    snaps = _snaps(path)
+    assert [s["profile"]["dropped_ids"] for s in snaps] == [0, 2, 2]
+
+
+# -- fedtop: percentile/staleness sections + live-tail guards ---------------
+
+def test_fedtop_sketch_sections_golden(capsys):
+    """Committed sketch-carrying fixture in, committed render out: the
+    percentile + staleness sections (ISSUE 10 acceptance) with exit codes
+    unchanged."""
+    fedtop = _load_tool("fedtop")
+    rc = fedtop.main([os.path.join(FIXTURES, "pulse_sketch.jsonl"), "--once"])
+    out = capsys.readouterr().out
+    golden = open(os.path.join(FIXTURES, "fedtop_sketch.txt")).read()
+    assert rc == 0
+    assert out == golden
+    assert "percentile: train p50" in out
+    assert "staleness : p50" in out and "rounds behind" in out
+    assert "3 id(s) beyond cap" in out          # dropped-id accounting
+    assert "profiles_dropped" in out            # ...and its watchdog warn
+
+
+def test_fedtop_tail_resets_on_truncated_stream(tmp_path):
+    """Live-tail guard: a reader whose offset outlives a truncate/rotate
+    (new run reusing the path) restarts from the top instead of seeking
+    past EOF and reading nothing forever; a torn trailing line still
+    defers to the next poll without consuming bytes."""
+    fedtop = _load_tool("fedtop")
+    p = tmp_path / "pulse.jsonl"
+    line1 = json.dumps({"v": 1, "ts_ms": 1, "round": 0, "source": "x"}) + "\n"
+    line2 = json.dumps({"v": 1, "ts_ms": 2, "round": 1, "source": "x"}) + "\n"
+    p.write_text(line1 + line2)
+    snaps, off = fedtop.read_snapshots(str(p))
+    assert [s["round"] for s in snaps] == [0, 1] and off == len(line1 + line2)
+    # the writer restarts the stream shorter than our offset
+    p.write_text(line1)
+    snaps, off = fedtop.read_snapshots(str(p), off)
+    assert [s["round"] for s in snaps] == [0] and off == len(line1)
+    # torn mid-append: nothing consumed past the last complete line
+    p.write_text(line1 + '{"v":1,"ts_ms":3,"rou')
+    snaps, off2 = fedtop.read_snapshots(str(p), off)
+    assert snaps == [] and off2 == off
+    # the write completes: the next poll picks the full line up
+    p.write_text(line1 + line2)
+    snaps, off3 = fedtop.read_snapshots(str(p), off2)
+    assert [s["round"] for s in snaps] == [1]
+    # rotation by REPLACEMENT (rename/recreate): the size guard can't see
+    # a new file that already regrew past the offset — file identity can
+    sig = fedtop.stream_signature(str(p))
+    assert sig is not None
+    q = tmp_path / "new.jsonl"
+    q.write_text(line1 + line2)
+    os.replace(str(q), str(p))               # same path, new inode
+    assert fedtop.stream_signature(str(p)) != sig
+    assert fedtop.stream_signature(str(tmp_path / "missing")) is None
+
+
+# -- the ISSUE 10 acceptance pin: 10k-cohort overhead budget ----------------
+
+#: the acceptance budget: full plane on within this fraction of plane-off
+OVERHEAD_BUDGET = 0.05
+
+
+def test_obs_overhead_budget_10k_cohort(tmp_path):
+    """A 10k-client-cohort round with the FULL plane on — sketch lanes +
+    deterministic sampled tracing + pulse stream — stays within 5% wall of
+    plane-off, and the model state is bit-identical. Measured as min round
+    wall over the post-warmup rounds (min filters scheduler contention on
+    the shared CI box; one documented re-measure for the same reason). The
+    measured delta lands in the ``[t1] obs-overhead:`` session line via
+    live.record_overhead."""
+    import time
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    def measure(tag, plane_on):
+        obs.reset()
+        ds = make_synthetic_crossdevice(
+            "obs-budget", 12, 4, 20_000, batch_size=8, mean_records=4.0,
+            max_records=8, seed=0)
+        pulse_path = None
+        kw = {}
+        if plane_on:
+            d = tmp_path / tag
+            pulse_path = str(d / "pulse.jsonl")
+            kw = dict(pulse_path=pulse_path, trace_dir=str(d / "trace"),
+                      trace_sample_rate=0.25)
+        cfg = FedConfig(model="lr", client_num_in_total=20_000,
+                        client_num_per_round=10_000, comm_round=6,
+                        batch_size=8, lr=0.1, frequency_of_the_test=10_000,
+                        seed=0, **kw)
+        api = FedAvgAPI(ds, cfg)
+        # the rounds are driven directly (train() would time eval/logging
+        # into the walls), so make the entry-point configure call ourselves
+        obs.configure_from(cfg)
+        float(api.run_round(0))            # warm: compile + first-touch
+        walls = []
+        for r in range(1, 4):
+            t0 = time.perf_counter()
+            float(api.run_round(r))
+            walls.append(time.perf_counter() - t0)
+        api.close()
+        obs.reset()
+        return api, min(walls), pulse_path
+
+    # a discarded warm-up arm first: the first federation in a fresh
+    # process runs measurably faster than every later one (allocator +
+    # code-path warm-up), which would otherwise bill ~15% of phantom
+    # "overhead" to whichever arm runs second
+    measure("warm", False)
+    for attempt in range(2):
+        off_api, off_wall, _ = measure(f"off{attempt}", False)
+        on_api, on_wall, pulse_path = measure(f"on{attempt}", True)
+        pct = (on_wall / off_wall - 1.0) * 100.0
+        if on_wall <= off_wall * (1.0 + OVERHEAD_BUDGET):
+            break
+    pulse_live.record_overhead(pct, OVERHEAD_BUDGET * 100.0)
+    assert on_wall <= off_wall * (1.0 + OVERHEAD_BUDGET), (
+        f"full plane costs {pct:+.2f}% wall over off "
+        f"(budget {OVERHEAD_BUDGET:.0%}; on {on_wall * 1e3:.1f} ms vs "
+        f"off {off_wall * 1e3:.1f} ms at 10k-client cohorts)")
+    # the plane read counters and clocks only: identical model state
+    for a, b in zip(jax.tree.leaves(on_api.variables),
+                    jax.tree.leaves(off_api.variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the plane actually ran: stream on disk, sketch lanes at cohort
+    # scale (10k clients x 4 rounds), profiles for every logical client
+    snaps = _snaps(pulse_path)
+    assert [s["round"] for s in snaps] == [0, 1, 2, 3]
+    assert snaps[-1]["sketches"]["train_ms"]["count"] == 40_000
+    # 4 draws of 10k/20k without replacement: most of the population seen
+    assert 15_000 < snaps[-1]["profile"]["clients_seen"] <= 20_000

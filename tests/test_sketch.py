@@ -1,0 +1,184 @@
+"""fedsketch (obs/sketch): the mergeable distribution-sketch contracts.
+
+ISSUE 10's tentpole math: deterministic log-bucket mapping with bounded
+relative error, EXACT merges (associative + commutative + insert-order
+independent — the property that makes cross-host folds lossless), the
+compact JSON codec round-trip, and the fixed-memory bound. Everything here
+is pure numpy/python — no jax, no clocks, no RNG beyond seeded generators.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from fedml_tpu.obs.sketch import Sketch, merge_all
+
+
+def _lognormal(n, seed=0, mu=3.0, sigma=1.5):
+    return np.random.default_rng(seed).lognormal(mu, sigma, n)
+
+
+# -- accuracy & determinism --------------------------------------------------
+
+def test_quantiles_within_relative_error():
+    """Every quantile estimate lands within ~alpha of the true empirical
+    quantile over a heavy-tailed sample (the DDSketch guarantee: each
+    VALUE's bucket representative is within alpha, so rank queries inherit
+    it up to one bucket of interpolation slack)."""
+    vals = _lognormal(20_000)
+    s = Sketch(alpha=0.01)
+    s.add(vals)
+    assert s.n == vals.size
+    for q in (0.01, 0.25, 0.5, 0.9, 0.99, 0.999):
+        est = s.quantile(q)
+        true = float(np.quantile(vals, q))
+        assert abs(est - true) / true < 2 * s.alpha, (q, est, true)
+
+
+def test_bucket_mapping_deterministic_and_scalar_batch_agree():
+    """The value->bucket map is a pure function: feeding the same values
+    scalar-by-scalar, in bulk, or via the count= repeat form produces the
+    IDENTICAL sketch (same encode bytes)."""
+    vals = _lognormal(500, seed=3)
+    bulk = Sketch()
+    bulk.add(vals)
+    onebyone = Sketch()
+    for v in vals:
+        onebyone.add(float(v))
+    assert bulk == onebyone
+    assert bulk.encode() == onebyone.encode()
+    rep, loop = Sketch(), Sketch()
+    rep.add(7.25, count=1000)
+    loop.add(np.full(1000, 7.25))
+    assert rep == loop
+
+
+def test_zero_negative_nan_inf_routing():
+    s = Sketch()
+    s.add([0.0, -3.0, float("nan"), float("-inf"), 5.0, float("inf")])
+    assert s.n == 6
+    assert s.zero == 4          # 0, negative, nan, -inf -> the zero bucket
+    assert s.quantile(0.0) == 0.0
+    assert s.quantile(1.0) >= s.max_value * 0.9   # +inf clamps to the top
+    # below-min / above-max clamp to the edge buckets, count stays exact
+    t = Sketch(min_value=1.0, max_value=100.0)
+    t.add([1e-9, 1e9])
+    assert t.n == 2 and t.zero == 0
+    assert t.quantile(0.0) <= 1.01 and t.quantile(1.0) >= 99.0
+
+
+# -- merge algebra (the cross-host contract) --------------------------------
+
+def test_merge_commutative_associative_order_independent():
+    vals = _lognormal(9_000, seed=1)
+    a, b, c = Sketch(), Sketch(), Sketch()
+    a.add(vals[:3000])
+    b.add(vals[3000:6000])
+    c.add(vals[6000:])
+    ab_c = merge_all([a, b, c])
+    c_ba = merge_all([c, b, a])
+    # (a+b)+c vs a+(b+c), explicitly
+    left = a.copy().merge(b).merge(c)
+    right = a.copy().merge(b.copy().merge(c))
+    bulk = Sketch()
+    bulk.add(vals)
+    shuffled = Sketch()
+    idx = np.arange(vals.size)
+    np.random.default_rng(9).shuffle(idx)
+    shuffled.add(vals[idx])
+    # every route to the same multiset is the same sketch, bit for bit
+    for other in (c_ba, left, right, bulk, shuffled):
+        assert ab_c == other
+        assert ab_c.encode() == other.encode()
+    assert ab_c.n == vals.size
+
+
+def test_merge_rejects_mismatched_universe():
+    a = Sketch(alpha=0.01)
+    b = Sketch(alpha=0.02)
+    with pytest.raises(ValueError, match="different universes"):
+        a.merge(b)
+    c = Sketch(min_value=1.0)
+    with pytest.raises(ValueError, match="different universes"):
+        a.merge(c)
+
+
+def test_merge_all_empty_and_single():
+    assert merge_all([]) is None
+    s = Sketch()
+    s.add([1.0, 2.0])
+    m = merge_all([s])
+    assert m == s and m is not s      # a copy, never an alias
+
+
+# -- codec -------------------------------------------------------------------
+
+def test_codec_json_round_trip_exact():
+    s = Sketch()
+    s.add(_lognormal(4_000, seed=5))
+    s.add([0.0, -1.0])                 # zero bucket rides the codec too
+    wire = json.dumps(s.encode(), separators=(",", ":"))
+    back = Sketch.decode(json.loads(wire))
+    assert back == s
+    assert back.summary() == s.summary()
+    # encodings of equal sketches are byte-equal (sorted pairs)
+    assert json.dumps(back.encode()) == json.dumps(s.encode())
+
+
+def test_codec_rejects_garbage():
+    with pytest.raises(ValueError, match="not a v1 sketch"):
+        Sketch.decode({"v": 2})
+    with pytest.raises(ValueError, match="not a v1 sketch"):
+        Sketch.decode("nope")
+
+
+# -- memory ------------------------------------------------------------------
+
+def test_fixed_memory_bound():
+    """The bucket universe is closed: pathological inputs spanning the
+    whole range (plus out-of-range clamps) can never allocate more than
+    max_bins sparse entries, and nbytes is measured, not asserted."""
+    s = Sketch()
+    vals = np.concatenate([
+        np.geomspace(1e-6, 1e18, 60_000),      # saturate + clamp both ends
+        _lognormal(10_000, seed=7),
+    ])
+    s.add(vals)
+    assert len(s._bins) <= s.max_bins
+    assert s.nbytes < 300_000, f"sparse store grew to {s.nbytes}"
+    assert s.n == vals.size
+
+
+def test_invalid_construction():
+    with pytest.raises(ValueError, match="alpha"):
+        Sketch(alpha=0.0)
+    with pytest.raises(ValueError, match="min_value"):
+        Sketch(min_value=-1.0)
+    with pytest.raises(ValueError, match="min_value"):
+        Sketch(min_value=10.0, max_value=1.0)
+    with pytest.raises(ValueError, match="q must be"):
+        Sketch().quantile(1.5)
+    with pytest.raises(ValueError, match="scalar"):
+        Sketch().add([1.0, 2.0], count=3)
+
+
+def test_since_is_the_exact_interval_delta():
+    """since(prev) on a cumulative sketch recovers exactly the sketch of
+    the interval's values — the per-round delta the pulse plane feeds the
+    watchdog's skew rule (a compile-heavy round 0 can never own a later
+    round's p99)."""
+    r0 = _lognormal(300, seed=1, mu=6.0)      # "compile round": big walls
+    r1 = _lognormal(300, seed=2, mu=2.0)      # steady round: small walls
+    cum = Sketch()
+    cum.add(r0)
+    snap0 = cum.copy()
+    cum.add(r1)
+    delta = cum.since(snap0)
+    only_r1 = Sketch()
+    only_r1.add(r1)
+    assert delta == only_r1                    # exact, bit for bit
+    # the cumulative tail is r0's; the interval tail is r1's own
+    assert cum.quantile(0.99) > 10 * delta.quantile(0.99)
+    with pytest.raises(ValueError, match="same universe"):
+        cum.since(Sketch(alpha=0.02))

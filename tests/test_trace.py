@@ -707,3 +707,241 @@ def test_timed_build_raising_first_call_retimed_not_recorded():
     after = g.get("first_call_ms", 0.0)
     step(5)
     assert g.get("first_call_ms", 0.0) == after
+
+
+# -- fedsketch: deterministic head-based span sampling (ISSUE 10) -----------
+
+def test_span_sampled_is_a_pure_function():
+    """The keep/drop verdict is a pure hash of (seed, round, entity): same
+    inputs -> same verdict, across calls and regardless of global state;
+    fractions track the rate; rate 0/1 are exact."""
+    from fedml_tpu.obs.tracer import span_sampled
+
+    keep = [r for r in range(2000) if span_sampled(r, rate=0.3, seed=11)]
+    assert keep == [r for r in range(2000) if span_sampled(r, rate=0.3, seed=11)]
+    assert 0.25 < len(keep) / 2000 < 0.35
+    assert all(span_sampled(r, rate=1.0, seed=11) for r in range(50))
+    assert not any(span_sampled(r, rate=0.0, seed=11) for r in range(50))
+    # seed and entity both shift the verdict stream (decorrelated heads)
+    assert keep != [r for r in range(2000) if span_sampled(r, rate=0.3, seed=12)]
+    assert keep != [r for r in range(2000)
+                    if span_sampled(r, 5, rate=0.3, seed=11)]
+    # a kept round at rate r stays kept at any higher rate (nested samples:
+    # raising --trace_sample_rate only ADDs rounds, never swaps them)
+    for r in range(200):
+        if span_sampled(r, rate=0.2, seed=11):
+            assert span_sampled(r, rate=0.6, seed=11)
+
+
+def test_sampled_tracing_sim_bit_identical_and_subset(tmp_path):
+    """The ISSUE 10 sampling pin (sim half): a --trace_sample_rate run
+    computes exactly the unsampled run's model state, and its trace holds
+    exactly the rounds span_sampled predicts — a bounded, reproducible
+    subset."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.obs.tracer import span_sampled
+
+    def run(trace_dir, rate):
+        obs.reset()
+        ds = make_synthetic_classification(
+            "tr-samp", (6,), 3, 4, records_per_client=8,
+            partition_method="homo", batch_size=4, seed=0)
+        cfg = FedConfig(model="lr", client_num_in_total=4,
+                        client_num_per_round=4, comm_round=8, batch_size=4,
+                        lr=0.1, frequency_of_the_test=100, seed=0,
+                        trace_dir=trace_dir, trace_sample_rate=rate)
+        api = FedAvgAPI(ds, cfg)
+        api.train()
+        return api
+
+    sampled = run(str(tmp_path / "s"), 0.5)
+    full = run(str(tmp_path / "f"), 1.0)
+    plain = run(None, 0.5)
+    for a, b, c in zip(jax.tree.leaves(sampled.variables),
+                       jax.tree.leaves(full.variables),
+                       jax.tree.leaves(plain.variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+    def round_spans(d):
+        events = [json.loads(l)
+                  for l in open(os.path.join(d, "trace-rank0.jsonl"))]
+        return {e["args"]["round"] for e in events
+                if e.get("name") == "round" and e.get("ph") == "X"}
+
+    predicted = {r for r in range(8) if span_sampled(r, rate=0.5, seed=0)}
+    assert round_spans(str(tmp_path / "s")) == predicted
+    assert predicted < set(range(8))          # a real subset...
+    assert predicted                          # ...but not empty
+    assert round_spans(str(tmp_path / "f")) == set(range(8))
+
+
+def test_sampled_tracing_grpc_edge_bit_identical(tmp_path):
+    """The ISSUE 10 sampling pin (edge half): a 4-rank grpc federation
+    under head sampling computes the unsampled weights, and every rank
+    agrees on the per-round verdict — the sampled trace has no rounds
+    missing ranks, it just has fewer rounds."""
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+    from fedml_tpu.obs.tracer import span_sampled
+
+    def run(trace_dir, rate, port):
+        obs.reset()
+        return run_fedavg_edge(
+            _edge_ds(), _edge_cfg(seed=1, trace_dir=trace_dir,
+                                  trace_sample_rate=rate),
+            worker_num=3,
+            comm_factory=lambda r: GRPCCommManager(
+                rank=r, size=4, base_port=port, host="127.0.0.1"))
+
+    on = run(str(tmp_path / "s"), 0.5, 56970)
+    off = run(None, 1.0, 56974)
+    assert [h["loss"] for h in on.test_history] \
+        == [h["loss"] for h in off.test_history]
+    for a, b in zip(jax.tree.leaves(on.get_global_model_params()),
+                    jax.tree.leaves(off.get_global_model_params())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    predicted = {r for r in range(2) if span_sampled(r, rate=0.5, seed=1)}
+    assert predicted == {1}    # seed 1 drops round 0, keeps round 1
+    per_rank_rounds = {}
+    for r in range(4):
+        path = tmp_path / "s" / f"trace-rank{r}.jsonl"
+        events = [json.loads(l) for l in open(path)] if path.exists() else []
+        per_rank_rounds[r] = {e["args"]["round"] for e in events
+                              if e.get("name") == "round"
+                              and e.get("ph") == "X"}
+    # every rank derived the SAME verdict: the kept round is on all ranks,
+    # the dropped round on none
+    assert all(rounds == predicted for rounds in per_rank_rounds.values()), \
+        per_rank_rounds
+
+
+def test_tracer_if_sampled_disabled_path_allocates_nothing():
+    """tracer_if_sampled keeps the disabled-path contract: tracing off is
+    one global read returning None, no hashing, no allocation."""
+    import tracemalloc
+
+    from fedml_tpu.obs.tracer import tracer_if_sampled
+
+    assert tracer_if_sampled(0, 0) is None
+    gc.collect()
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for r in range(2000):
+        tr = tracer_if_sampled(0, r)
+        if tr is not None:                    # never taken: tracing is off
+            tr.instant("x")
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    growth = sum(s.size_diff for s in after.compare_to(before, "lineno")
+                 if s.size_diff > 0)
+    assert growth < 64_000, f"disabled tracer_if_sampled leaked {growth} bytes"
+
+
+# -- fedsketch: simulated two-host sketch merge golden (ISSUE 10) -----------
+
+def test_two_host_sketch_merge_golden(tmp_path, capsys):
+    """Two hosts' pulse streams (the per-host flush naming) sit beside a
+    trace: trace_report folds their sketch lanes with the exact merge and
+    reports ONE distribution. The merged numbers are golden — pure integer
+    bucket addition over a deterministic map, so they can never drift."""
+    from fedml_tpu.obs.sketch import Sketch
+
+    d = tmp_path / "tr"
+    d.mkdir()
+    with open(d / "trace-rank0.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"ph": "X", "name": "round", "cat": "round", "ts": 10,
+             "rank": 0, "dur": 5, "sid": 1, "args": {"round": 0}}) + "\n")
+
+    def host_stream(name, train_vals, stale_vals):
+        tr_sk, st_sk = Sketch(), Sketch()
+        tr_sk.add(train_vals)
+        st_sk.add(stale_vals)
+        snap = {"v": 1, "ts_ms": 1, "round": 0, "source": "edge_server",
+                "sketches": {
+                    "train_ms": {**tr_sk.summary(), "enc": tr_sk.encode()},
+                    "staleness": {**st_sk.summary(), "enc": st_sk.encode()}}}
+        with open(d / name, "w") as f:
+            f.write(json.dumps(snap) + "\n")
+        return tr_sk, st_sk
+
+    # host 0 is the fast host, host 1 the slow one: only the MERGED view
+    # sees the true p90/p99 (each host alone would report its own tail)
+    a_tr, a_st = host_stream("pulse.jsonl", [10.0] * 90, [0.0] * 90)
+    b_tr, b_st = host_stream("pulse-p1.jsonl", [1000.0] * 10, [4.0] * 10)
+
+    tr = _load_trace_report()
+    rc = tr.main([str(d)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "merged across 2 pulse stream(s)" in out
+    # golden: the merged lanes equal a single sketch fed with everything
+    merged_tr = a_tr.copy().merge(b_tr).summary()
+    merged_st = a_st.copy().merge(b_st).summary()
+    assert merged_tr["count"] == 100 and merged_st["count"] == 100
+    # p50 from the fast host, p99 from the slow one — within 1% buckets
+    assert abs(merged_tr["p50"] - 10.0) / 10.0 < 0.02
+    assert abs(merged_tr["p99"] - 1000.0) / 1000.0 < 0.02
+    assert merged_st["p50"] == 0.0 and merged_st["p99"] > 3.5
+    # the report's rendered numbers ARE the merged sketches' numbers
+    assert "(n=100)" in out
+    assert f"p99 {merged_tr['p99']:>10g}" in out
+    assert f"p99 {merged_st['p99']:>10g}" in out
+
+
+def test_sketch_merge_tolerates_mismatched_and_corrupt_streams(
+        tmp_path, capsys):
+    """Exit-code contract under bad inputs: a host launched with a
+    different --sketch_alpha (unmergeable universe) or a corrupted 'enc'
+    is skipped with a stderr note — the report still renders what merges
+    and exits by the span graph alone."""
+    from fedml_tpu.obs.sketch import Sketch
+
+    d = tmp_path / "tr"
+    d.mkdir()
+    with open(d / "trace-rank0.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"ph": "X", "name": "round", "cat": "round", "ts": 10,
+             "rank": 0, "dur": 5, "sid": 1, "args": {"round": 0}}) + "\n")
+
+    def stream(name, sk_dict):
+        with open(d / name, "w") as f:
+            f.write(json.dumps({"v": 1, "ts_ms": 1, "round": 0,
+                                "source": "x", "sketches": sk_dict}) + "\n")
+
+    good = Sketch()
+    good.add([10.0] * 50)
+    other = Sketch(alpha=0.02)           # different universe: won't merge
+    other.add([99.0] * 50)
+    stream("pulse.jsonl",
+           {"train_ms": {**good.summary(), "enc": good.encode()}})
+    stream("pulse-p1.jsonl",
+           {"train_ms": {**other.summary(), "enc": other.encode()},
+            "staleness": {"count": 1, "enc": {"v": 99, "garbage": True}}})
+    tr = _load_trace_report()
+    rc = tr.main([str(d)])
+    out = capsys.readouterr()
+    assert rc == 0                        # span graph is clean -> exit 0
+    assert "different --sketch_alpha" in out.err
+    assert "undecodable sketch 'staleness'" in out.err
+    # the deterministic winner (finest alpha on the stream-count/sample
+    # tie) is the default-universe stream; the excluded one does NOT
+    # inflate the reported stream count
+    assert "merged across 1 pulse stream(s)" in out.out
+    assert "(n=50)" in out.out
+    assert "p50     10.075" in out.out    # the winner's data, not ~99
+
+
+def test_superstep_block_follows_head_sampling_verdict(tmp_path):
+    """The packed-mesh superstep path emits its superstep + amortized
+    mesh_round spans only for blocks whose STARTING round is sampled —
+    span volume stays bounded under --trace_sample_rate on the one path
+    that bypasses the per-round wrapper's gate."""
+    from fedml_tpu.obs.tracer import span_sampled
+
+    obs.configure(str(tmp_path), sample_rate=0.5, sample_seed=1)
+    tr_kept = obs.tracer_if_sampled(0, 1)    # seed 1 keeps round 1...
+    tr_dropped = obs.tracer_if_sampled(0, 0)  # ...and drops round 0
+    assert span_sampled(1, seed=1) and not span_sampled(0, seed=1)
+    assert tr_kept is not None and tr_dropped is None

@@ -16,7 +16,9 @@ LAST artifact that carries it is compared against the PREVIOUS artifact
 that carries it; a drop of more than ``--threshold`` (default 10%) is a
 regression. Metrics appear and disappear across the series (mfu starts at
 r02, crossdevice at r05) — comparison only ever pairs artifacts where the
-metric is present.
+metric is present. Trajectory-only columns (the fedsketch p99 train-ms /
+staleness tails, which are lower-is-better) render in the table but never
+feed the gate.
 
 Exit codes: 0 trajectory clean; 1 regression(s) detected (listed on
 stderr); 2 nothing to analyze — no artifacts, or none parseable.
@@ -31,30 +33,46 @@ import os
 import re
 import sys
 
-#: metric -> (extractor over the bench JSON, short label). Every metric is
-#: higher-is-better; regression = relative drop beyond the threshold.
+def _sketch(j: dict, lane: str, q: str):
+    """Missing-key-tolerant reach into the tail's fedsketch block (the
+    flagship profiler aggregates); r01-r05 artifacts predate it -> None."""
+    return (((j.get("profiler") or {}).get("sketches") or {})
+            .get(lane) or {}).get(q)
+
+
+#: metric -> (extractor over the bench JSON, short label, gated). Gated
+#: metrics are higher-is-better; regression = relative drop beyond the
+#: threshold. gated=False rows are TRAJECTORY-ONLY columns (the fedsketch
+#: latency/staleness tails are lower-is-better, so a drop-based gate would
+#: invert their meaning — they render for the reader, never flake the gate).
 METRICS = {
-    "img_per_sec": (lambda j: j.get("value"), "flagship img/s"),
-    "vs_baseline": (lambda j: j.get("vs_baseline"), "vs_baseline"),
-    "mfu": (lambda j: j.get("mfu"), "mfu"),
+    "img_per_sec": (lambda j: j.get("value"), "flagship img/s", True),
+    "vs_baseline": (lambda j: j.get("vs_baseline"), "vs_baseline", True),
+    "mfu": (lambda j: j.get("mfu"), "mfu", True),
     "crosssilo_img_per_sec": (
         lambda j: (j.get("crosssilo") or {}).get("images_per_sec"),
-        "cross-silo img/s"),
+        "cross-silo img/s", True),
     "clients_per_sec": (
         lambda j: (j.get("crossdevice") or {}).get("clients_per_sec"),
-        "cross-device clients/s"),
+        "cross-device clients/s", True),
     # MAC-basis MFU over the fedcost lane ceiling (in the tail since the
     # PR-6 roofline block): the schedule-quality headline — a drop means
     # the round program stopped filling the lanes the model shapes allow
     "mfu_vs_lane_ceiling": (
-        lambda j: j.get("mfu_vs_lane_ceiling"), "mfu/ceiling"),
+        lambda j: j.get("mfu_vs_lane_ceiling"), "mfu/ceiling", True),
     # fedpack (PR-9 packed_conv A/B block): the packed lowering's static
     # output-lane ceiling — the lane-ceiling LIFT the client packing buys.
     # Absent on r01-r08 artifacts (extractor returns None, never a gate
     # flake on missing keys).
     "packed_lane_ceiling": (
         lambda j: (j.get("packed_conv") or {}).get("out_lane_ceiling"),
-        "packed ceiling"),
+        "packed ceiling", True),
+    # fedsketch distribution tails from the profiler block (ISSUE 10):
+    # per-client p99 train-ms and the p99 rounds-behind staleness spread
+    "p99_train_ms": (
+        lambda j: _sketch(j, "train_ms", "p99"), "p99 train-ms", False),
+    "p99_staleness": (
+        lambda j: _sketch(j, "staleness", "p99"), "p99 staleness", False),
 }
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -105,7 +123,7 @@ def load_series(paths: list[str]) -> list[dict]:
             continue
         n, bench = parsed
         row = {"n": n, "path": os.path.basename(p)}
-        for key, (fn, _label) in METRICS.items():
+        for key, (fn, _label, _gated) in METRICS.items():
             try:
                 v = fn(bench)
             except Exception:
@@ -119,7 +137,9 @@ def load_series(paths: list[str]) -> list[dict]:
 def detect_regressions(rows: list[dict], threshold: float) -> list[str]:
     """Last-present vs previous-present comparison per metric."""
     regressions = []
-    for key, (_fn, label) in METRICS.items():
+    for key, (_fn, label, gated) in METRICS.items():
+        if not gated:
+            continue
         present = [(r["n"], r[key]) for r in rows if r[key] is not None]
         if len(present) < 2:
             continue
@@ -135,7 +155,7 @@ def detect_regressions(rows: list[dict], threshold: float) -> list[str]:
 
 
 def format_table(rows: list[dict]) -> str:
-    heads = ["run"] + [label for _k, (_f, label) in METRICS.items()]
+    heads = ["run"] + [label for _k, (_f, label, _g) in METRICS.items()]
     widths = [max(len(h), 10) for h in heads]
     out = ["  ".join(h.rjust(w) for h, w in zip(heads, widths))]
     for r in rows:

@@ -5,7 +5,9 @@ Tails the ``pulse.jsonl`` a run writes under ``--pulse_path`` (obs/live)
 and renders the federation's live state: round progress and rates
 (rounds/s, clients/s), train/eval loss, MAC-basis MFU against the fedcost
 lane ceiling, wire anomalies, the per-client profile summary with the
-top-k stragglers, and the health watchdog's verdict:
+top-k stragglers, the fedsketch percentile lanes (train/upload/payload
+p50/p90/p99) with the rounds-behind staleness spread, and the health
+watchdog's verdict:
 
     python tools/fedtop.py /tmp/run/pulse.jsonl            # live (1s poll)
     python tools/fedtop.py /tmp/run/pulse.jsonl --once     # one snapshot
@@ -34,10 +36,21 @@ import time
 
 def read_snapshots(path: str, offset: int = 0) -> tuple[list[dict], int]:
     """Parse snapshots from byte ``offset`` on; returns (snaps, new offset).
-    A trailing torn line (mid-append reader) is left for the next poll."""
+
+    Two writer races are guarded here so live tailing can never wedge or
+    tear a snapshot: a TRAILING TORN LINE (the reader catching the
+    ``O_APPEND`` writer mid-write — the kernel may expose a prefix of one
+    ``os.write``) is left un-consumed for the next poll (``offset`` only
+    ever advances past complete newline-terminated lines), and a file that
+    SHRANK below our offset (a new run truncating/rotating the stream)
+    resets the tail to the start instead of seeking past EOF and reading
+    empty forever."""
     snaps: list[dict] = []
     try:
         with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            if f.tell() < offset:
+                offset = 0   # stream was truncated/rotated under us
             f.seek(offset)
             data = f.read()
     except OSError:
@@ -54,6 +67,18 @@ def read_snapshots(path: str, offset: int = 0) -> tuple[list[dict], int]:
         if isinstance(snap, dict) and "round" in snap:
             snaps.append(snap)
     return snaps, offset + end
+
+
+def stream_signature(path: str):
+    """File identity for live-tail rotation detection: a new run that
+    REPLACES the pulse file (rename/recreate) changes (st_dev, st_ino)
+    even when it regrows past our offset faster than a poll interval —
+    size alone cannot see that. None while the file is missing."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_dev, st.st_ino)
 
 
 def _rates(snaps: list[dict]) -> dict:
@@ -145,6 +170,26 @@ def render(snaps: list[dict], path: str, stalled_s: float = 0.0) -> str:
             lines.append("stragglers: " + " · ".join(
                 f"#{s['client']} {s['ema_ms']:g}ms(x{s['rounds']})"
                 for s in strag))
+    # fedsketch percentile + staleness sections (absent on pre-sketch
+    # streams, so older fixtures render byte-identically)
+    sk = last.get("sketches") or {}
+
+    def _pct(s: dict, unit: str) -> str:
+        return (f"p50 {s.get('p50', 0):g} · p90 {s.get('p90', 0):g}"
+                f" · p99 {s.get('p99', 0):g}{unit}   (n={s.get('count', 0)})")
+
+    pct_rows = [(label, sk[lane], unit) for lane, label, unit in
+                (("train_ms", "train", " ms"),
+                 ("upload_ms", "upload", " ms"),
+                 ("payload_bytes", "payload", " B"))
+                if lane in sk]
+    if pct_rows:
+        lines.append("percentile: " + " | ".join(
+            f"{label} {_pct(s, unit)}" for label, s, unit in pct_rows[:1]))
+        for label, s, unit in pct_rows[1:]:
+            lines.append(f"            {label} {_pct(s, unit)}")
+    if "staleness" in sk:
+        lines.append("staleness : " + _pct(sk["staleness"], " rounds behind"))
     events = [e for s in snaps
               for e in (s.get("health") or {}).get("events", ())]
     if events:
@@ -179,6 +224,7 @@ def main(argv=None) -> int:
         return 1 if state == "critical" else 0
 
     last_new = time.monotonic()
+    sig = stream_signature(args.pulse)
     try:
         while True:
             if snaps:
@@ -191,6 +237,15 @@ def main(argv=None) -> int:
                 sys.stdout.write(f"fedtop: waiting for {args.pulse} ...\n")
             sys.stdout.flush()
             time.sleep(args.interval)
+            cur_sig = stream_signature(args.pulse)
+            if cur_sig != sig:
+                # a new run replaced the stream: restart the tail clean —
+                # keeping the old run's snapshots would mix two runs'
+                # histories (wrong first-loss, wrong round sequence), and
+                # the size-only guard in read_snapshots cannot catch a
+                # replacement that regrew past our offset within one poll
+                sig, offset = cur_sig, 0
+                snaps.clear()
             fresh, offset = read_snapshots(args.pulse, offset)
             if fresh:
                 snaps.extend(fresh)

@@ -12,8 +12,10 @@ out next to the dot count, so each PR can see its budget profile:
     python tools/t1_report.py /tmp/_t1.log
 
 Report: DOTS (passed-in-window, the gate's own regex), outcome summary
-line, failure/error names, the slowest-10 test files, and the
-compile-cache line. ``--json`` emits the same as one JSON object.
+line, failure/error names, the slowest-10 test files, the compile-cache
+line, and the obs-overhead line (the pinned full-plane-on vs off wall
+delta from the fedsketch budget test). ``--json`` emits the same as one
+JSON object.
 
 Exit codes: 0 parsed; 2 when the file has no pytest progress output at all
 (wrong file / empty log).
@@ -41,6 +43,7 @@ SUMMARY_RE = re.compile(
 FAIL_RE = re.compile(r"^(FAILED|ERROR) (\S+)")
 FILE_SECONDS_RE = re.compile(r"^\[t1\] file-seconds: (\[.*\])\s*$")
 CACHE_RE = re.compile(r"^\[t1\] compile-cache: (.*)$")
+OBS_OVERHEAD_RE = re.compile(r"^\[t1\] obs-overhead: (.*)$")
 
 
 def parse_log(text: str) -> dict:
@@ -50,6 +53,7 @@ def parse_log(text: str) -> dict:
     summary = None
     file_seconds: list = []
     cache_line = None
+    obs_overhead = None
     for line in text.splitlines():
         line = line.rstrip()
         if DOTS_RE.match(line):
@@ -73,6 +77,10 @@ def parse_log(text: str) -> dict:
         m = CACHE_RE.match(line)
         if m:
             cache_line = m.group(1)
+            continue
+        m = OBS_OVERHEAD_RE.match(line)
+        if m:
+            obs_overhead = m.group(1)
     return {
         "dots": dots,
         "dots_baseline": BASELINE_DOTS,
@@ -83,6 +91,7 @@ def parse_log(text: str) -> dict:
         "failures": failures,
         "slowest_files": file_seconds[:10],
         "compile_cache": cache_line,
+        "obs_overhead": obs_overhead,
     }
 
 
@@ -100,6 +109,8 @@ def format_report(rep: dict) -> str:
         lines.append(f"summary: {rep['summary']}")
     if rep["compile_cache"]:
         lines.append(f"compile-cache: {rep['compile_cache']}")
+    if rep.get("obs_overhead"):
+        lines.append(f"obs-overhead: {rep['obs_overhead']}")
     if rep["slowest_files"]:
         lines.append("slowest files (wall seconds in this session):")
         for path, secs in rep["slowest_files"]:

@@ -40,7 +40,13 @@ Report sections:
   rank granularity — the profiler's per-client EMA train-ms ranking,
   participation fairness, and the stream's health verdict join the
   per-rank causal-chain ranking. Absent the file, the report (and every
-  existing golden) is unchanged.
+  existing golden) is unchanged,
+- distribution sketches (fedsketch): every ``pulse*.jsonl`` stream in the
+  directory contributes its last snapshot's mergeable lane encodings
+  (sketches are run-cumulative); the lanes fold ACROSS hosts with the
+  exact order-independent merge, so a multi-host run's p50/p90/p99
+  train-ms / upload-latency / payload / staleness read as one
+  distribution. Streams without sketches add nothing.
 
 Exit codes: 0 clean; 1 structural anomalies — unclosed spans, rounds
 missing on some rank, recv spans with no matching send (span imbalance) —
@@ -405,16 +411,76 @@ def analyze(events: list[dict], expect_ranks: int = 0) -> dict:
     return rep
 
 
-def load_pulse(trace_dir: str) -> Optional[list]:
-    """Snapshots from a ``pulse.jsonl`` beside the trace files, or None.
-    The parsing (skip blanks/torn lines, keep round-carrying dicts) is
-    fedtop's ``read_snapshots`` — ONE implementation of the JSONL
-    contract, so the two tools can never diverge on what they accept."""
-    path = os.path.join(trace_dir, "pulse.jsonl")
-    if not os.path.exists(path):
+def load_pulse_streams(trace_dir: str) -> dict:
+    """Every ``pulse*.jsonl`` stream in the dir -> {basename: snapshots}.
+    A single-host run has one (``pulse.jsonl``, the primary stream the
+    client-profiles join reads); a multi-host run flushes one per host
+    into the shared directory (any ``pulse*.jsonl`` name). The parsing
+    (skip blanks/torn lines, keep round-carrying dicts) is fedtop's
+    ``read_snapshots`` — ONE implementation of the JSONL contract, so the
+    two tools can never diverge on what they accept."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir, "pulse*.jsonl"))):
+        snaps, _offset = read_snapshots(path)
+        if snaps:
+            out[os.path.basename(path)] = snaps
+    return out
+
+
+def sketch_section(streams: dict) -> Optional[dict]:
+    """Cross-host fedsketch fold: decode each stream's LAST snapshot's lane
+    encodings (run-cumulative, so the last snapshot IS the stream) and
+    merge per lane. The merge is exact, commutative and order-independent
+    (obs/sketch contract), so the result is independent of host order and
+    identical to a sketch fed by one process observing everything. The
+    reported stream count is the streams that actually CONTRIBUTED a lane
+    — a pre-sketch host's stream beside a sketch-carrying one must not
+    read as two-host coverage."""
+    from fedml_tpu.obs.sketch import Sketch
+
+    lanes: dict = {}          # lane -> [(stream name, Sketch)]
+    for name, snaps in streams.items():
+        for lane, s in (snaps[-1].get("sketches") or {}).items():
+            if not (isinstance(s, dict) and s.get("enc")):
+                continue
+            try:
+                sk = Sketch.decode(s["enc"])
+            except (ValueError, KeyError, TypeError):
+                # one corrupted encoding must not kill the report — the
+                # JSONL layer is torn-line tolerant, this layer matches it
+                print(f"trace_report: skipping undecodable sketch "
+                      f"'{lane}' in {name}", file=sys.stderr)
+                continue
+            lanes.setdefault(lane, []).append((name, sk))
+    merged = {}
+    contributed = set()
+    for lane, entries in sorted(lanes.items()):
+        # hosts launched with different --sketch_alpha produce unmergeable
+        # universes: group per universe and fold the DETERMINISTIC winner
+        # (most streams, then most samples, then finest alpha) — never an
+        # accident of filename sort order — and only streams whose data is
+        # actually IN the fold count toward the reported stream total
+        groups: dict = {}
+        for name, sk in entries:
+            key = (sk.alpha, sk.min_value, sk.max_value)
+            groups.setdefault(key, []).append((name, sk))
+        win = max(groups, key=lambda k: (len(groups[k]),
+                                         sum(s.n for _n, s in groups[k]),
+                                         -k[0]))
+        skipped = [n for k, v in groups.items() if k != win for n, _s in v]
+        if skipped:
+            print(f"trace_report: '{lane}' sketches from "
+                  f"{sorted(skipped)} use a different universe (hosts ran "
+                  "different --sketch_alpha?) — excluded from the merge",
+                  file=sys.stderr)
+        out = groups[win][0][1].copy()
+        for _name, sk in groups[win][1:]:
+            out.merge(sk)
+        merged[lane] = out.summary()
+        contributed.update(n for n, _s in groups[win])
+    if not merged:
         return None
-    snaps, _offset = read_snapshots(path)
-    return snaps or None
+    return {"streams": len(contributed), "lanes": merged}
 
 
 def client_profiles_section(snaps: list) -> dict:
@@ -517,6 +583,16 @@ def format_report(rep: dict) -> str:
                          f"  over {s['rounds']} round(s)")
         lines.append(f"  health: {cp.get('health_state') or 'n/a'}, "
                      f"{cp['critical_events']} critical event(s)")
+    sk = rep.get("sketches")
+    if sk:
+        lines.append("")
+        lines.append(f"distribution sketches (fedsketch, merged across "
+                     f"{sk['streams']} pulse stream(s)):")
+        for lane, s in sk["lanes"].items():
+            lines.append(
+                f"  {lane:<14} p50 {s.get('p50', 0):>10g}  "
+                f"p90 {s.get('p90', 0):>10g}  p99 {s.get('p99', 0):>10g}  "
+                f"(n={s['count']})")
     costsec = rep.get("cost")
     if costsec:
         lines.append("")
@@ -610,11 +686,18 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     rep = analyze(events, expect_ranks=args.expect_ranks)
-    pulse = load_pulse(args.trace_dir)
+    # one parse pass over every pulse*.jsonl: the primary stream feeds the
+    # client-profiles join, all streams feed the cross-host sketch fold
+    streams = load_pulse_streams(args.trace_dir)
+    pulse = streams.get("pulse.jsonl")
     if pulse:
         # additive join: exit codes and the span-graph sections are
         # untouched — a pulse-less trace dir reports exactly as before
         rep["client_profiles"] = client_profiles_section(pulse)
+    if streams:
+        merged = sketch_section(streams)
+        if merged:
+            rep["sketches"] = merged
     if args.perfetto:
         write_chrome_trace(args.perfetto, events)
         rep["perfetto"] = args.perfetto
