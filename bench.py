@@ -182,47 +182,81 @@ def _bench_packed_conv_ab(ds, base_cfg, model: str, rounds: int, peak):
     from fedml_tpu.obs import cost as fedcost
 
     mode = os.environ.get("BENCH_PACKED_CONV_MODE", "blockdiag")
-    out = {"mode": mode, "img_per_sec": {}, "mfu_vs_lane_ceiling": {},
-           "mfu_mac_useful": {}}
-    ceilings = {}
-    for arm in dict.fromkeys(("off", mode)):
-        # force residency so the CPU smoke exercises the same packed
-        # (device-resident) schedule branch the TPU run measures
-        cfg = base_cfg.replace(packed_conv=arm, device_data="on")
-        bundle = create_model(model, 10, dtype=jnp.bfloat16,
-                              input_shape=ds.train_x.shape[2:],
-                              bn_impl=os.environ.get("BENCH_BN", "xla"),
-                              conv_impl=os.environ.get("BENCH_CONV", "xla"))
-        fedcost.reset_cost_tables()
-        api = FedAvgAPI(ds, cfg, bundle)
-        for _pass in range(2):        # same two-pass warm as the headline
+
+    def measure_arms(api_cls, pick_table, cfg_extra=None):
+        """One A/B (off vs ``mode``) through the shared measurement
+        discipline — two warm passes, one timed pass, real-img/s +
+        static-ceiling + roofline per arm — so the sgd flagship and the
+        adaptive arm below stay comparable in the same JSON tail."""
+        res = {"img_per_sec": {}, "mfu_vs_lane_ceiling": {},
+               "mfu_mac_useful": {}}
+        ceilings = {}
+        for arm in dict.fromkeys(("off", mode)):
+            # force residency so the CPU smoke exercises the same packed
+            # (device-resident) schedule branch the TPU run measures
+            cfg = base_cfg.replace(packed_conv=arm, device_data="on",
+                                   **(cfg_extra or {}))
+            bundle = create_model(
+                model, 10, dtype=jnp.bfloat16,
+                input_shape=ds.train_x.shape[2:],
+                bn_impl=os.environ.get("BENCH_BN", "xla"),
+                conv_impl=os.environ.get("BENCH_CONV", "xla"))
+            fedcost.reset_cost_tables()
+            api = api_cls(ds, cfg, bundle)
+            for _pass in range(2):    # same two-pass warm as the headline
+                for r in range(1, rounds + 1):
+                    last = api.run_round(r)
+                float(last)
+            t0 = time.perf_counter()
             for r in range(1, rounds + 1):
                 last = api.run_round(r)
             float(last)
-        t0 = time.perf_counter()
-        for r in range(1, rounds + 1):
-            last = api.run_round(r)
-        float(last)
-        dt = time.perf_counter() - t0
-        real = sum(api.round_counts(r)[0] for r in range(1, rounds + 1))
-        out["img_per_sec"][arm] = round(real * EPOCHS / dt, 1)
-        rec = max(fedcost.cost_tables().values(),
-                  key=lambda r: r["summary"]["gemm_flops_per_invocation"],
-                  default=None)
-        if rec is not None:
-            ceilings[arm] = rec["summary"]["out_lane_ceiling"]
-            rf = fedcost.roofline(rec["summary"], dt, invocations=rounds,
-                                  peak=peak)
-            out["mfu_vs_lane_ceiling"][arm] = rf.get("mfu_vs_ceiling")
-            out["mfu_mac_useful"][arm] = rf.get("mfu_mac_useful",
-                                                rf.get("mfu_mac"))
-    off = out["img_per_sec"].get("off")
-    on = out["img_per_sec"].get(mode)
-    out["speedup"] = round(on / off, 3) if (off and on) else None
-    # the packed program's static ceiling — the lane lift the packing buys
-    # (bench_report tracks this across the artifact series)
-    out["out_lane_ceiling"] = ceilings.get(mode)
-    out["off_lane_ceiling"] = ceilings.get("off")
+            dt = time.perf_counter() - t0
+            real = sum(api.round_counts(r)[0] for r in range(1, rounds + 1))
+            res["img_per_sec"][arm] = round(real * EPOCHS / dt, 1)
+            rec = pick_table()
+            if rec is not None:
+                ceilings[arm] = rec["summary"]["out_lane_ceiling"]
+                rf = fedcost.roofline(rec["summary"], dt, invocations=rounds,
+                                      peak=peak)
+                res["mfu_vs_lane_ceiling"][arm] = rf.get("mfu_vs_ceiling")
+                res["mfu_mac_useful"][arm] = rf.get("mfu_mac_useful",
+                                                    rf.get("mfu_mac"))
+        off, on = res["img_per_sec"].get("off"), res["img_per_sec"].get(mode)
+        res["speedup"] = round(on / off, 3) if (off and on) else None
+        # the packed program's static ceiling — the lane lift the packing
+        # buys (bench_report tracks this across the artifact series)
+        res["out_lane_ceiling"] = ceilings.get(mode)
+        res["off_lane_ceiling"] = ceilings.get("off")
+        return res
+
+    def biggest_table():
+        return max(fedcost.cost_tables().values(),
+                   key=lambda r: r["summary"]["gemm_flops_per_invocation"],
+                   default=None)
+
+    out = dict({"mode": mode}, **measure_arms(FedAvgAPI, biggest_table))
+
+    # packed-everywhere (ISSUE 12): one ADAPTIVE arm through the identical
+    # harness — FedOpt with a stateful server optimizer rides the same
+    # packed round program (hooks + threaded server state), so its
+    # per-lowering img/s and static ceiling land in the tail next to the
+    # sgd flagship's. BENCH_PACKED_CONV_OPT names the server optimizer
+    # ('off' disables the arm); bench_report's `fedopt ceiling` column is
+    # missing-key tolerant for pre-ISSUE-12 artifacts.
+    server_opt = os.environ.get("BENCH_PACKED_CONV_OPT", "adam")
+    if server_opt not in ("", "off", "0"):
+        from fedml_tpu.algorithms.fedopt import FedOptAPI
+
+        def fedopt_table():
+            # the class-qualified record for exactly the program measured
+            return (fedcost.table_for("packed_step.FedOptAPI")
+                    or biggest_table())
+
+        out["fedopt"] = dict(
+            {"server_optimizer": server_opt},
+            **measure_arms(FedOptAPI, fedopt_table,
+                           {"server_optimizer": server_opt}))
     return out
 
 

@@ -29,15 +29,13 @@ class FedAGCAPI(FedAvgAPI):
         stacked["params"] = clipped_params
         return tree_weighted_mean(stacked, counts), server_state
 
-
-class CrossSiloFedAGCAPI(CrossSiloFedAvgAPI, FedAGCAPI):
-    """FedAGC on the cross-silo mesh path: the unit-wise AGC clip is a pure
-    per-client transform of the locally-trained weights, so it runs on each
-    device BEFORE the weighted psum — no server rank needed at all (the
-    fork's SiloFedAGC._aggregate, silo_fedagc.py:50-69, does the same math
-    after an MPI gather)."""
-
     def crosssilo_hooks(self):
+        """The hook form of :meth:`aggregate` — on the BASE class because
+        the unit-wise clip is a pure per-client transform that both
+        non-vmap execution forms apply at the same point: pre-psum on the
+        mesh path, at lane emit on the packed schedule
+        (FedAvgAPI._packing_hooks) — so FedAGC rides the packed MXU fast
+        path in every paradigm."""
         clipping = self.clipping
 
         def client_transform(gvars, stacked):
@@ -48,3 +46,10 @@ class CrossSiloFedAGCAPI(CrossSiloFedAvgAPI, FedAGCAPI):
             return out
 
         return dict(client_transform=client_transform)
+
+
+class CrossSiloFedAGCAPI(CrossSiloFedAvgAPI, FedAGCAPI):
+    """FedAGC on the cross-silo mesh path: the unit-wise AGC clip runs on
+    each device BEFORE the weighted psum — no server rank needed at all
+    (the fork's SiloFedAGC._aggregate, silo_fedagc.py:50-69, does the same
+    math after an MPI gather; hooks on FedAGCAPI.crosssilo_hooks)."""

@@ -80,6 +80,9 @@ class FedAvgAPI:
             input_shape=dataset.train_x.shape[2:] or None,
         )
         self.task = get_task(dataset.task, dataset.class_num)
+        #: Silo per-client exit mask (set_client_active); None = all active
+        self._client_active = None
+        self._client_active_version = 0
         self.root_key = seed_everything(config.seed)
         self.variables = self.bundle.init(self.root_key)
         self._local_train = self.build_local_train()
@@ -446,22 +449,64 @@ class FedAvgAPI:
 
     # -- packed schedule (parallel/packed.py) --------------------------------
 
+    def _packing_hooks(self) -> Optional[dict]:
+        """The packed schedule's algorithm contract (packed-everywhere):
+        the weighted mean folds INTO the lane scan, and everything beyond
+        it rides the SAME three-hook contract the mesh paradigm uses
+        (crosssilo_hooks: client_transform at lane emit, reduce_extras
+        accumulated in the scan, server_update post-aggregation with
+        threaded server state). Returns ``{}`` for plain weighted-mean
+        algorithms, the hook dict for the zoo (FedOpt/FedNova/AGC/robust —
+        hooks now live on the BASE algorithm classes), or None when
+        packing cannot mirror this subclass (rewired build_local_train,
+        or a custom aggregate() with no hook translation)."""
+        if type(self).build_local_train is not FedAvgAPI.build_local_train:
+            if not getattr(self, "_warned_no_pack", False):
+                log.warning(
+                    "pack_lanes=%d ignored: %s rewires build_local_train, "
+                    "which the packed lane builder cannot mirror",
+                    self.config.pack_lanes, type(self).__name__)
+                self._warned_no_pack = True
+            return None
+        hooks = self.crosssilo_hooks()
+        if hooks is None:
+            if type(self).aggregate is not FedAvgAPI.aggregate:
+                if not getattr(self, "_warned_no_pack", False):
+                    log.warning(
+                        "pack_lanes=%d ignored: %s overrides aggregate() "
+                        "without crosssilo hooks", self.config.pack_lanes,
+                        type(self).__name__)
+                    self._warned_no_pack = True
+                return None
+            hooks = {}
+        return hooks
+
     def _packing_supported(self) -> bool:
-        """Packing folds the weighted mean INTO the lane scan, so it only
-        serves algorithms whose aggregation is the plain weighted mean with
-        stateless servers (FedAvg, FedProx — prox is client-side, injected
-        via _local_train_kwargs). A subclass that rewires build_local_train
-        itself can't be mirrored by the packed lane builder and falls back."""
-        ok = (type(self).aggregate is FedAvgAPI.aggregate
-              and type(self).init_server_state is FedAvgAPI.init_server_state
-              and type(self).build_local_train is FedAvgAPI.build_local_train)
-        if not ok and not getattr(self, "_warned_no_pack", False):
-            log.warning(
-                "pack_lanes=%d ignored: %s customizes aggregation/server "
-                "state, which the packed schedule folds into its lanes",
-                self.config.pack_lanes, type(self).__name__)
-            self._warned_no_pack = True
-        return ok
+        return self._packing_hooks() is not None
+
+    def packed_status(self) -> dict:
+        """Introspection for the packed-coverage contract (the tier-1
+        matrix test pins it): ``{"scheduled": <packed schedule applies>,
+        "packed_conv_active": <joint MXU form engages>, "reason": <None or
+        the documented fallback reason>}``. After packed-everywhere the
+        only honest reasons left are the DESIGN.md §15 exception table —
+        models without a packed twin, flax-rng dropout without an
+        explicit-key twin, flag off, or an algorithm the lane builder
+        cannot mirror."""
+        from fedml_tpu.parallel.packed import packed_fallback_reason
+
+        c = self.config
+        if c.pack_lanes <= 0:
+            return {"scheduled": False, "packed_conv_active": False,
+                    "reason": "pack_lanes=0"}
+        if not self._packing_supported():
+            return {"scheduled": False, "packed_conv_active": False,
+                    "reason": f"{type(self).__name__} has no packed-lane "
+                              "algorithm mirror"}
+        reason = packed_fallback_reason(self.bundle, c.packed_conv,
+                                        c.client_optimizer)
+        return {"scheduled": True, "packed_conv_active": reason is None,
+                "reason": reason}
 
     def _packed_plan(self, sampled: np.ndarray):
         from fedml_tpu.parallel.packed import plan_packing
@@ -482,25 +527,37 @@ class FedAvgAPI:
         return plan
 
     def build_round_step_packed(self, shape_key: tuple):
+        from fedml_tpu.parallel.crosssilo import apply_server_and_rollback
         from fedml_tpu.parallel.packed import (make_packed_cohort_train,
                                                packed_conv_active)
 
         c = self.config
         n_pad = int(self.dataset.train_x.shape[1])
+        hooks = self._packing_hooks() or {}
+        server_update = hooks.get("server_update")
+        has_extras = hooks.get("reduce_extras") is not None
         packed = make_packed_cohort_train(
             self.bundle, self.task, n_pad, shape_key,
-            packed_conv=c.packed_conv, **self._local_train_kwargs())
+            packed_conv=c.packed_conv,
+            client_transform=hooks.get("client_transform"),
+            reduce_extras=hooks.get("reduce_extras"),
+            **self._local_train_kwargs())
 
         @jax.jit
-        def round_step(variables, tx, ty, tm, rows, weights, rng, plan_arrays):
-            acc, acc_w, acc_loss, _tau = packed(
+        def round_step(variables, server_state, tx, ty, tm, rows, weights,
+                       rng, plan_arrays):
+            acc, acc_w, acc_loss, _tau, extras = packed(
                 variables, tx, ty, tm, rows, weights, rng, plan_arrays)
             denom = jnp.maximum(acc_w, 1e-12)
-            keep = acc_w > 0    # elastic guard, as in _finish_round
-            new_vars = jax.tree.map(
-                lambda a, v: jnp.where(keep, (a / denom).astype(v.dtype), v),
-                acc, variables)
-            return new_vars, acc_loss / denom
+            agg = jax.tree.map(
+                lambda a, v: (a / denom).astype(v.dtype), acc, variables)
+            # the one shared post-aggregation tail (crosssilo.py): server
+            # hook on the aggregate with the round's server key, elastic
+            # all-failed rollback of weights AND server state
+            new_vars, new_state = apply_server_and_rollback(
+                variables, agg, extras if has_extras else None, acc_w,
+                server_state, rng, server_update)
+            return new_vars, new_state, acc_loss / denom
 
         # fedcost packing hint (obs/cost.attribute_program): the joint
         # form's block-diag dots stream n_lanes x the useful FLOPs; the
@@ -515,7 +572,11 @@ class FedAvgAPI:
 
     def _run_packed_round(self, sampled, live, rk):
         """Execute the round under the packed schedule; returns (variables,
-        loss) or None when packing doesn't apply this round."""
+        server_state, loss) or None when packing doesn't apply this round.
+        ``live`` already folds the Silo client-active mask (_round_plan);
+        exited clients additionally get the STRUCTURAL lane freeze — their
+        plan steps masked dead (mask_plan_arrays) in the same compiled
+        program, never a vmap fallback."""
         if not self._packing_supported():
             return None
         plan = self._packed_plan(sampled)
@@ -527,11 +588,18 @@ class FedAvgAPI:
                               "packed_step")
         counts = np.asarray(self.dataset.train_counts, np.float32)[sampled]
         weights = counts if live is None else counts * np.asarray(live, np.float32)
-        plan_arrays = (plan.slot, plan.epoch, plan.sie, plan.reset, plan.emit,
-                       plan.live, plan.member_pos, plan.member_valid,
-                       plan.steps_real)
+        active = self._client_active
+        if active is None:
+            from fedml_tpu.parallel.packed import plan_arrays_tuple
+
+            plan_arrays = plan_arrays_tuple(plan)
+        else:
+            from fedml_tpu.parallel.packed import mask_plan_arrays
+
+            plan_arrays = mask_plan_arrays(
+                plan, np.asarray(active, np.float32)[sampled][plan.member_pos])
         tx, ty, tm, _tc = self._dev_train
-        return step(self.variables, tx, ty, tm,
+        return step(self.variables, self.server_state, tx, ty, tm,
                     jnp.asarray(sampled, jnp.int32), jnp.asarray(weights),
                     rk, tuple(jnp.asarray(a) for a in plan_arrays))
 
@@ -568,10 +636,29 @@ class FedAvgAPI:
             self.history.setdefault("failed_clients", []).append(n_failed)
         return live
 
+    def set_client_active(self, active) -> None:
+        """Per-client participation mask (the Silo harness's per-client
+        early EXIT, algorithms/silo.py): a client whose entry is 0 stops
+        contributing — its aggregation weight zeroes on every schedule,
+        and the packed paths additionally freeze its lane span structurally
+        (parallel/packed.mask_plan_arrays) inside the SAME compiled
+        program. ``active``: [num_clients] {0,1}-ish, or None to clear.
+        Takes effect from the next round (next superstep BLOCK on the
+        packed-mesh superstep path — the block is one device program)."""
+        if active is None:
+            self._client_active = None
+        else:
+            a = np.asarray(active, np.float32)
+            self._client_active = None if a.all() else a
+        self._client_active_version += 1
+
     def _round_plan(self, round_idx: int, record: bool = False):
         """The deterministic per-round plan: (sampled cohort, live mask,
         scan bucket). run_round executes exactly this plan; round_counts
-        reports it — one source of truth for what a round trains on."""
+        reports it — one source of truth for what a round trains on.
+        The Silo client-active mask folds into ``live`` here, so every
+        host-cohort/gather/grouped/packed schedule honors an exit the same
+        way it honors an injected failure: weight zero."""
         c = self.config
         sampled = sample_clients(round_idx, self.dataset.num_clients
                                  if c.client_num_in_total > self.dataset.num_clients
@@ -579,6 +666,9 @@ class FedAvgAPI:
                                  min(c.client_num_per_round, self.dataset.num_clients),
                                  seed=c.seed)
         live = self._sample_failures(round_idx, len(sampled), record=record)
+        if self._client_active is not None:
+            av = self._client_active[sampled]
+            live = av if live is None else live * av
         bucket = self._round_bucket(sampled, live)
         return sampled, live, bucket
 
@@ -837,7 +927,7 @@ class FedAvgAPI:
             if self.config.pack_lanes > 0:
                 out = self._run_packed_round(sampled, live, rk)
                 if out is not None:
-                    self.variables, train_loss = out
+                    self.variables, self.server_state, train_loss = out
                     return (train_loss if self.config.async_rounds
                             else float(train_loss))
             plan = self._round_groups(sampled, live)
@@ -1098,24 +1188,11 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         )
 
         c, ds = self.config, self.dataset
-        if type(self).build_local_train is not FedAvgAPI.build_local_train:
-            if not getattr(self, "_warned_no_pack", False):
-                log.warning(
-                    "pack_lanes=%d ignored: %s rewires build_local_train, "
-                    "which the packed lane builder cannot mirror",
-                    c.pack_lanes, type(self).__name__)
-                self._warned_no_pack = True
-            return None
-        try:
-            # the mesh form supports the full hook contract (FedOpt/FedNova/
-            # AGC/robust server state and transforms ride the lanes)
-            hooks = self._crosssilo_hooks_checked()
-        except NotImplementedError:
-            if not getattr(self, "_warned_no_pack", False):
-                log.warning(
-                    "pack_lanes=%d ignored: %s overrides aggregate() without "
-                    "crosssilo hooks", c.pack_lanes, type(self).__name__)
-                self._warned_no_pack = True
+        # ONE packability gate for both paradigms (_packing_hooks): the
+        # mesh and sim packed paths must agree on which algorithms mirror
+        # onto the lanes — a condition added to one must gate the other
+        hooks = self._packing_hooks()
+        if hooks is None:
             return None
         if cohort != ds.num_clients:
             log.warning(
@@ -1140,12 +1217,12 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         from fedml_tpu.parallel.mesh import shard_client_batch
 
         n_pad = int(ds.train_x.shape[1])
+        from fedml_tpu.parallel.packed import plan_arrays_tuple
+
         data = shard_client_batch(self.mesh, (
             x[perm], np.asarray(ds.train_y)[perm],
             np.asarray(ds.train_mask)[perm]))
-        plan_arrays = shard_client_batch(self.mesh, (
-            plan.slot, plan.epoch, plan.sie, plan.reset, plan.emit, plan.live,
-            plan.member_pos, plan.member_valid, plan.steps_real))
+        plan_arrays = shard_client_batch(self.mesh, plan_arrays_tuple(plan))
         from fedml_tpu.obs import timed_build
 
         # fedscope compile telemetry: the packed mesh program is the most
@@ -1393,9 +1470,13 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         rks = jnp.stack([round_key(self.root_key, start + i)
                          for i in range(blk)])
         (w_dev,) = shard_client_batch(self.mesh, (w,))
+        # client-active exits ride the superstep too: masked w (caller) +
+        # masked plan arrays, picked up at each block START — a mid-block
+        # mask change takes effect at the next block boundary (the block
+        # is one device program; see set_client_active)
         step_args = (self.variables, self.server_state, *pm["data"], w_dev,
                      jnp.asarray(pm["perm"], jnp.int32), rks,
-                     pm["plan_arrays"])
+                     self._mesh_plan_arrays())
         tr = tracer_if_sampled(0, start)
         if tr is None:
             out = fns[blk](*step_args)
@@ -1419,6 +1500,29 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         self.variables, self.server_state, losses = out
         return losses
 
+    def _mesh_plan_arrays(self):
+        """The packed-mesh plan arrays, with the Silo client-active mask
+        applied as a STRUCTURAL lane freeze (mask_plan_arrays) when set —
+        re-placed over the mesh once per mask version, so exits cost one
+        host->device plan upload, never a recompile (shapes unchanged)."""
+        pm = self._packed_mesh
+        if self._client_active is None:
+            return pm["plan_arrays"]
+        cached = getattr(self, "_masked_mesh_plan", None)
+        if cached is not None and cached[0] == self._client_active_version:
+            return cached[1]
+        from fedml_tpu.parallel.mesh import shard_client_batch
+        from fedml_tpu.parallel.packed import (mask_plan_arrays,
+                                               mesh_member_active)
+
+        ma = mesh_member_active(
+            pm["plan"], self.mesh.shape["clients"],
+            np.asarray(self._client_active, np.float32)[pm["perm"]])
+        placed = shard_client_batch(self.mesh,
+                                    mask_plan_arrays(pm["plan"], ma))
+        self._masked_mesh_plan = (self._client_active_version, placed)
+        return placed
+
     def _run_round_inner(self, round_idx: int) -> float:
         if self._packed_mesh is not None:
             from fedml_tpu.parallel.mesh import shard_client_batch
@@ -1426,6 +1530,10 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
             pm = self._packed_mesh
             live = self._sample_failures(round_idx, self.dataset.num_clients)
             w = pm["counts_perm"]
+            if self._client_active is not None:
+                # weight-zero exits everywhere; the packed program also gets
+                # the structural lane freeze via _mesh_plan_arrays
+                w = w * np.asarray(self._client_active, np.float32)[pm["perm"]]
             h = self._superstep_h()
             if h > 1 and live is None:
                 # super-step block: round_idx falls in block
@@ -1455,11 +1563,15 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                 self._traced_device_step(
                     "packed_mesh", round_idx, pm["round_fn"],
                     self.variables, self.server_state, *pm["data"], w_dev,
-                    jnp.asarray(pm["perm"], jnp.int32), rk, pm["plan_arrays"])
+                    jnp.asarray(pm["perm"], jnp.int32), rk,
+                    self._mesh_plan_arrays())
             return train_loss if self.config.async_rounds else float(train_loss)
         if self._dev_groups is not None:
             groups, counts_res = self._dev_groups
             live = self._sample_failures(round_idx, self.dataset.num_clients)
+            if self._client_active is not None:
+                live = (self._client_active if live is None
+                        else live * self._client_active)
             if live is not None:
                 counts = tuple(
                     c * jnp.asarray(live[idx_g], jnp.float32)
@@ -1476,6 +1588,9 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
             return super()._run_round_inner(round_idx)
         cx, cy, cm, counts = self._dev_sharded
         live = self._sample_failures(round_idx, self.dataset.num_clients)
+        if self._client_active is not None:
+            live = (self._client_active if live is None
+                    else live * self._client_active)
         if live is not None:
             counts = counts * jnp.asarray(live, jnp.float32)
         rk = round_key(self.root_key, round_idx)
@@ -1498,6 +1613,8 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
                                      record=False)
         if live is not None:
             counts = counts * live
+        if self._client_active is not None:
+            counts = counts * self._client_active
         if self._packed_mesh is not None:
             plan = self._packed_mesh["plan"]
             padded = (plan.executed_slots * self.config.batch_size

@@ -64,22 +64,21 @@ class FedNovaAPI(FedAvgAPI):
         new_vars["params"] = new_params
         return new_vars, server_state
 
-
-class CrossSiloFedNovaAPI(CrossSiloFedAvgAPI, FedNovaAPI):
-    """FedNova on the cross-silo mesh path. The normalized-update math
-    decomposes into weighted partial sums that ride the same all-reduce as
-    the parameters:
-
-        pd = sum_i (n_i / a_i) (w_global - w_i)     (leafwise, psum'd)
-        na = sum_i  n_i * a_i                       (scalar,   psum'd)
-        w_next = w_global - na * pd / n_total^2
-
-    which equals the simulation form  w - tau_eff * sum_i p_i d_i  with
-    tau_eff = na/n_total and p_i = n_i/n_total — the reference runs this
-    as a rank-0 aggregation over MPI-gathered state dicts
-    (standalone/fednova/fednova_trainer.py:97-124); here it is one psum."""
-
     def crosssilo_hooks(self):
+        """The hook decomposition of :meth:`aggregate` into weighted
+        partial sums — on the BASE class because it is the aggregation
+        contract of both non-vmap execution forms (the mesh psum tail AND
+        the packed lane schedule's simulation round,
+        FedAvgAPI._packing_hooks):
+
+            pd = sum_i (n_i / a_i) (w_global - w_i)     (leafwise)
+            na = sum_i  n_i * a_i                       (scalar)
+            w_next = w_global - na * pd / n_total^2
+
+        which equals the simulation form  w - tau_eff * sum_i p_i d_i
+        with tau_eff = na/n_total and p_i = n_i/n_total — the reference
+        runs this as a rank-0 aggregation over MPI-gathered state dicts
+        (standalone/fednova/fednova_trainer.py:97-124)."""
         rho = float(self.config.momentum)
 
         def reduce_extras(gvars, res, w):
@@ -105,3 +104,9 @@ class CrossSiloFedNovaAPI(CrossSiloFedAvgAPI, FedNovaAPI):
             return new_vars, server_state
 
         return dict(reduce_extras=reduce_extras, server_update=server_update)
+
+
+class CrossSiloFedNovaAPI(CrossSiloFedAvgAPI, FedNovaAPI):
+    """FedNova on the cross-silo mesh path: the partial sums from
+    FedNovaAPI.crosssilo_hooks ride the same all-reduce as the parameters
+    — one psum, no server rank."""

@@ -64,16 +64,13 @@ class FedOptAPI(FedAvgAPI):
         new_vars["params"] = new_params
         return new_vars, {"opt": opt_state}
 
-
-class CrossSiloFedOptAPI(CrossSiloFedAvgAPI, FedOptAPI):
-    """FedOpt on the cross-silo mesh path: the weighted psum produces the
-    client average on every device, then the server optimizer step runs
-    replicated post-collective — the in-mesh counterpart of the reference's
-    rank-0 FedOptAggregator (distributed/fedopt/FedOptAggregator.py:70-120),
-    with no server rank and the optimizer state threaded through the one
-    jitted round program."""
-
     def crosssilo_hooks(self):
+        """The hook translation of :meth:`aggregate` — defined on the BASE
+        class (not the CrossSilo variant) because it is the shared
+        aggregation contract of BOTH non-vmap execution forms: the mesh
+        psum tail AND the packed lane schedule's simulation round
+        (FedAvgAPI._packing_hooks), so FedOpt rides the packed MXU fast
+        path in every paradigm."""
         tx = self._server_tx
 
         def server_update(vars0, agg, extras, total, server_state, rng):
@@ -87,3 +84,12 @@ class CrossSiloFedOptAPI(CrossSiloFedAvgAPI, FedOptAPI):
             return new_vars, {"opt": opt_state}
 
         return dict(server_update=server_update)
+
+
+class CrossSiloFedOptAPI(CrossSiloFedAvgAPI, FedOptAPI):
+    """FedOpt on the cross-silo mesh path: the weighted psum produces the
+    client average on every device, then the server optimizer step runs
+    replicated post-collective — the in-mesh counterpart of the reference's
+    rank-0 FedOptAggregator (distributed/fedopt/FedOptAggregator.py:70-120),
+    with no server rank and the optimizer state threaded through the one
+    jitted round program (hooks on FedOptAPI.crosssilo_hooks)."""

@@ -31,7 +31,21 @@ log = logging.getLogger(__name__)
 
 
 class SiloRunner:
-    """Early-stopping round loop around an algorithm API."""
+    """Early-stopping round loop around an algorithm API.
+
+    Two stopping scopes:
+
+    - GLOBAL (``patience``): the fork's validation-driven stop — training
+      ends when the global metric stalls (silo_fedavg.py:87-95).
+    - PER-CLIENT (``client_patience``, off by default): a client whose own
+      metric stalls EXITS the federation — its aggregation weight zeroes
+      on every schedule, and under the packed schedule its lane span
+      becomes a structural no-op in the SAME compiled program
+      (FedAvgAPI.set_client_active -> parallel/packed.mask_plan_arrays):
+      masked lane freeze/exit, never a vmap fallback or a recompile.
+      Exits take effect from the next round (next superstep block on the
+      packed-mesh superstep path).
+    """
 
     def __init__(
         self,
@@ -43,6 +57,8 @@ class SiloRunner:
         min_delta: float = 0.0,
         model_dir: Optional[str] = None,
         history_save_fn: Optional[Callable[[dict], None]] = None,
+        client_patience: Optional[int] = None,
+        client_min_delta: float = 0.0,
     ):
         # silo mode: every client participates every round (silo_fedavg.py:55)
         config = config.replace(
@@ -54,6 +70,12 @@ class SiloRunner:
         self.min_delta = min_delta
         self.model_dir = model_dir
         self.history_save_fn = history_save_fn
+        self.client_patience = client_patience
+        self.client_min_delta = client_min_delta
+        n = self.api.dataset.num_clients
+        self._client_best = np.full(n, -np.inf)
+        self._client_stall = np.zeros(n, np.int64)
+        self._client_on = np.ones(n, bool)
         self.history: dict[str, list] = defaultdict(list)
         self.best_metric = -np.inf
         self.best_round = -1
@@ -89,11 +111,41 @@ class SiloRunner:
             self.history["GLOBAL/Test/Acc"].append(gm.get("acc"))
             self.history["GLOBAL/Test/Loss"].append(gm.get("loss"))
             # per-client histories (fork logs Client.<id> metrics,
-            # instances/client.py:59-60)
+            # instances/client.py:59-60) + per-client early EXIT
             if r % cfg.frequency_of_the_test == 0:
+                exited = False
                 for c in range(self.api.dataset.num_clients):
+                    if not self._client_on[c]:
+                        # exited clients stop costing eval passes too —
+                        # None keeps the per-round history lists aligned
+                        self.history[f"Client.{c}/Train/Acc"].append(None)
+                        continue
                     cm = self._eval_client(c)
                     self.history[f"Client.{c}/Train/Acc"].append(cm.get("acc"))
+                    if self.client_patience:
+                        cv = self._validation_metric(cm)
+                        if cv > self._client_best[c] + self.client_min_delta:
+                            self._client_best[c] = cv
+                            self._client_stall[c] = 0
+                        else:
+                            self._client_stall[c] += 1
+                            if self._client_stall[c] >= self.client_patience:
+                                self._client_on[c] = False
+                                exited = True
+                                self.history[
+                                    f"Client.{c}/stopped_round"].append(r)
+                                log.info("client %d early-exits at round %d "
+                                         "(best %g)", c, r,
+                                         self._client_best[c])
+                if exited:
+                    if not self._client_on.any():
+                        # everyone exited: stop instead of training no-op
+                        # (all-zero-weight, elastic-rollback) rounds
+                        log.info("all clients early-exited at round %d", r)
+                        self.api.set_client_active(None)
+                        break
+                    self.api.set_client_active(
+                        self._client_on.astype(np.float32))
 
             if val > self.best_metric + self.min_delta:
                 self.best_metric, self.best_round, stall = val, r, 0
@@ -128,6 +180,12 @@ def SiloFedOpt(dataset, config, **kw) -> SiloRunner:
     from fedml_tpu.algorithms.fedopt import FedOptAPI
 
     return SiloRunner(dataset, config, FedOptAPI, **kw)
+
+
+def SiloFedProx(dataset, config, **kw) -> SiloRunner:
+    from fedml_tpu.algorithms.fedprox import FedProxAPI
+
+    return SiloRunner(dataset, config, FedProxAPI, **kw)
 
 
 def SiloFedNova(dataset, config, **kw) -> SiloRunner:

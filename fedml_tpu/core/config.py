@@ -163,9 +163,11 @@ class FedConfig:
     # granularity to one batch per client plus the lane tail. 0 = off.
     # Each client's trajectory replays the canonical unbucketed program
     # exactly; the aggregate matches up to float summation order. Overrides
-    # bucket_groups on the device-resident simulation path; requires the
-    # algorithm's aggregation to be the plain weighted mean (falls back
-    # with a warning otherwise).
+    # bucket_groups on the device-resident simulation path; serves every
+    # algorithm with a plain weighted mean OR a crosssilo_hooks contract
+    # (FedOpt/FedNova/FedAGC/robust — server state threads through the
+    # packed round); only rewired build_local_train / hookless custom
+    # aggregate() fall back, with a warning.
     pack_lanes: int = 0
     # fedpack conv lowering for the packed schedule's lane axis
     # (ops/packed_conv.py): how the K co-scheduled lanes' same-shape convs
@@ -176,10 +178,14 @@ class FedConfig:
     # the price of K x streamed FLOPs, reported honestly by fedcost's
     # packing_factor column); "grouped" runs one feature_group_count=K
     # convolution (useful FLOPs only; XLA picks the MXU mapping). Applies
-    # wherever pack_lanes schedules lanes (sim + cross-silo mesh) for
-    # conv models with sgd clients; other configurations fall back to the
-    # per-lane vmap with a warning. Numerics match the vmap lowering up to
-    # GEMM summation order (tests/test_packed_conv.py).
+    # wherever pack_lanes schedules lanes (sim + cross-silo mesh). The
+    # joint form is the DEFAULT abstraction (packed-everywhere, DESIGN.md
+    # §15): every client optimizer (stacked per-lane optax state),
+    # explicit-key dropout models and the Silo variants ride it; only the
+    # documented exception table (no packed twin / flax-rng dropout) falls
+    # back, warned once + counted in the "packed" registry lane. Numerics
+    # match the vmap lowering up to GEMM summation order
+    # (tests/test_packed_conv.py, tests/test_packed_everywhere.py).
     packed_conv: str = "off"
     # Cross-silo super-step: fold H consecutive rounds into ONE jitted
     # program (lax.scan over round keys) on the packed resident-sharded

@@ -41,6 +41,13 @@ class ModelBundle:
     task: str = "classification"
     has_batch_stats: bool = False
     uses_dropout: bool = False
+    #: explicit-key dropout (ops/packed_conv.seed_dropout): apply_train
+    #: hands ``rng`` to the module as a ``dropout_rng`` kwarg instead of a
+    #: flax rng stream, so the derivation is replayable per lane by the
+    #: packed twin (which receives the [K] vector of lane keys). Models
+    #: opt in per-module; a dropout model WITHOUT it keeps the vmap
+    #: fallback under --packed_conv (parallel/packed.packed_fallback_reason).
+    explicit_dropout: bool = False
     #: fedpack hook (ops/packed_conv.py): ``packed_variant(impl)`` returns a
     #: TRAIN-ONLY bundle whose module consumes lane-major [K, N, ...] input
     #: and whose parameter tree is the standard tree with a leading K axis
@@ -54,15 +61,20 @@ class ModelBundle:
         return self.module.init({"params": rng}, x, train=False)
 
     def apply_train(self, variables: dict, x: jax.Array, rng: jax.Array):
-        rngs = {"dropout": rng} if self.uses_dropout else {}
+        rngs, kwargs = {}, {}
+        if self.explicit_dropout:
+            kwargs["dropout_rng"] = rng     # raw key(s); module derives masks
+        elif self.uses_dropout:
+            rngs = {"dropout": rng}
         if self.has_batch_stats:
             logits, updated = self.module.apply(
-                variables, x, train=True, mutable=["batch_stats"], rngs=rngs
+                variables, x, train=True, mutable=["batch_stats"], rngs=rngs,
+                **kwargs
             )
             new_vars = dict(variables)
             new_vars.update(updated)
             return logits, new_vars
-        out = self.module.apply(variables, x, train=True, rngs=rngs)
+        out = self.module.apply(variables, x, train=True, rngs=rngs, **kwargs)
         return out, variables
 
     def apply_eval(self, variables: dict, x: jax.Array) -> jax.Array:

@@ -67,20 +67,59 @@ class CNNOriginalFedAvg(nn.Module):
 
 
 class CNNDropOut(nn.Module):
+    """Dropout masks derive from an EXPLICIT key (`dropout_rng`, the step's
+    batch key) via ops/packed_conv.seed_dropout instead of a flax rng
+    stream, so the packed lane-major twin replays each lane's masks
+    bit-for-bit from that lane's own key (ModelBundle.explicit_dropout)."""
+
     output_dim: int = 62
+    conv_impl: str = "xla"   # "packed": fedpack lane-major body
+    packed_impl: str = "blockdiag"
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, dropout_rng=None):
+        from fedml_tpu.ops.packed_conv import seed_dropout
+
+        if self.conv_impl == "packed":
+            return self._call_packed(x, train, dropout_rng)
         if x.ndim == 2:
             x = x.reshape((x.shape[0], 28, 28, 1))
         x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
         x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = seed_dropout(x, dropout_rng, 0.25, 0, not train)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(128)(x))
-        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = seed_dropout(x, dropout_rng, 0.5, 1, not train)
         return nn.Dense(self.output_dim)(x)
+
+    def _call_packed(self, x, train: bool, dropout_rng):
+        """fedpack body (x [K, N, 28, 28, 1] or [K, N, 784] lane-major;
+        dropout_rng the [K] vector of per-lane batch keys): same submodule
+        call order as the per-client body, so the parameter tree is the
+        standard tree with a leading K axis; lane l's dropout masks are
+        bit-identical to the per-client body's under dropout_rng[l]."""
+        from fedml_tpu.ops.packed_conv import Conv as PConv
+        from fedml_tpu.ops.packed_conv import Dense as PDense
+        from fedml_tpu.ops.packed_conv import lane_dropout
+
+        if x.ndim == 3:  # [K, N, 784] -> [K, N, 28, 28, 1]
+            x = x.reshape(x.shape[:2] + (28, 28, 1))
+        k = x.shape[0]
+
+        def pool(y):
+            flat = y.reshape((-1,) + y.shape[2:])
+            flat = nn.max_pool(flat, (2, 2), strides=(2, 2))
+            return flat.reshape((k, -1) + flat.shape[1:])
+
+        x = nn.relu(PConv(32, 3, padding="VALID", impl=self.packed_impl)(x))
+        x = nn.relu(PConv(64, 3, padding="VALID", impl=self.packed_impl)(x))
+        x = pool(x)
+        x = lane_dropout(x, dropout_rng, 0.25, 0, not train)
+        x = x.reshape(x.shape[:2] + (-1,))
+        x = nn.relu(PDense(128)(x))
+        x = lane_dropout(x, dropout_rng, 0.5, 1, not train)
+        return PDense(self.output_dim)(x)
 
 
 @register_model("cnn")
@@ -103,9 +142,20 @@ def _cnn(output_dim: int, **_):
 
 @register_model("cnn_dropout")
 def _cnn_dropout(output_dim: int, **_):
-    return ModelBundle(
+    bundle = ModelBundle(
         name="cnn_dropout",
         module=CNNDropOut(output_dim),
         input_shape=(28, 28, 1),
         uses_dropout=True,
+        explicit_dropout=True,
     )
+    # fedpack hook: explicit_dropout marks the twin's per-lane key stream,
+    # which is what clears packed_fallback_reason's dropout gate
+    bundle.packed_variant = lambda impl: ModelBundle(
+        name="cnn_dropout_packed",
+        module=CNNDropOut(output_dim, conv_impl="packed", packed_impl=impl),
+        input_shape=(28, 28, 1),
+        uses_dropout=True,
+        explicit_dropout=True,
+    )
+    return bundle

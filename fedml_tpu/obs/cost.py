@@ -679,6 +679,20 @@ def cost_tables() -> dict:
         return dict(_TABLES)
 
 
+def table_for(name_prefix: str) -> Optional[dict]:
+    """The attribution record for one PROGRAM by name prefix — the
+    class-qualified program names ("packed_step.FedOptAPI",
+    "gather_step.FedProxAPI", ...) make a process running several API
+    types hold one record per program, and consumers (bench.py's adaptive
+    packed arm, reports) should select the program they measured instead
+    of max-by-FLOPs guessing. Longest matching name wins on ties."""
+    with _lock:
+        hits = [k for k in _TABLES if k.startswith(name_prefix)]
+        if not hits:
+            return None
+        return _TABLES[max(hits, key=len)]
+
+
 def reset_cost_tables() -> None:
     with _lock:
         _TABLES.clear()

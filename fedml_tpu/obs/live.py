@@ -63,8 +63,9 @@ __all__ = [
     "session_stats",
 ]
 
-#: registry namespaces exported as pulse "lanes" every snapshot
-_LANES = ("time", "wire", "chaos", "compile")
+#: registry namespaces exported as pulse "lanes" every snapshot ("packed"
+#: carries the fedpack fallback counters, parallel/packed.py)
+_LANES = ("time", "wire", "chaos", "compile", "packed")
 
 #: process-lifetime stats for the conftest session summary (NEVER reset by
 #: configure()/reset() — they describe the session, not one run).

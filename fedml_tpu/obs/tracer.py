@@ -530,7 +530,10 @@ def flush_all(trace_dir: Optional[str] = None) -> list[str]:
 def reset() -> None:
     """Drop all tracers and disable tracing (tests; never mid-run). Also
     tears down the fedpulse plane — a plane leaked across tests would feed
-    every later run_round in the process."""
+    every later run_round in the process — and the packed-schedule
+    fallback accounting (warn-once set + "packed" registry counter lane),
+    so a second federation in one process warns and counts afresh instead
+    of inheriting the first's suppression."""
     global _ENABLED, _TRACE_DIR, _TRACE_ID, _PROCESS
     global _SAMPLE_RATE, _SAMPLE_SEED
     with _lock:
@@ -544,3 +547,8 @@ def reset() -> None:
     from fedml_tpu.obs import live as _live
 
     _live.reset()
+    import sys
+
+    packed = sys.modules.get("fedml_tpu.parallel.packed")
+    if packed is not None:   # only if already imported — never import here
+        packed.reset_fallback_warnings()

@@ -56,8 +56,51 @@ __all__ = [
     "stack_variables", "unstack_variables",
     "block_diag_weight", "block_diag_unstack",
     "conv_blockdiag", "conv_grouped", "conv_vmap",
+    "seed_dropout", "lane_dropout",
     "Conv", "BatchNorm", "Dense",
 ]
+
+#: salt folded (plus the per-model layer index) into the explicit dropout
+#: key so distinct dropout layers in one step draw independent masks —
+#: the same fold-a-constant derivation the packed replay tables use
+#: (parallel/local.EPOCH_KEY_SALT)
+DROPOUT_KEY_SALT = 0xD120
+
+
+def seed_dropout(x, key, rate: float, layer: int, deterministic: bool):
+    """Explicit-key dropout — ONE derivation shared by the per-client and
+    the packed lane-major lowerings, so the joint form can replay a lane's
+    masks bit-for-bit from the lane's own batch key (flax's ``nn.Dropout``
+    derives its key from internal module-path folding, which the packed
+    twin cannot reproduce per lane). ``layer`` is the call site's static
+    index within the model; ``key`` is the step's batch key (models
+    receive it as ``dropout_rng``; see ModelBundle.explicit_dropout)."""
+    if deterministic or rate <= 0.0:
+        return x
+    if key is None:
+        # same contract as flax's missing-rng error: a train-mode apply
+        # without a key must fail loudly, not silently skip regularization
+        raise ValueError(
+            "seed_dropout: train-mode apply without a dropout key — pass "
+            "dropout_rng (ModelBundle.explicit_dropout threads it)")
+    k = jax.random.fold_in(key, DROPOUT_KEY_SALT + layer)
+    keep = jax.random.bernoulli(k, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def lane_dropout(xs, keys, rate: float, layer: int, deterministic: bool):
+    """Packed (lane-major) form of :func:`seed_dropout`: ``xs`` is
+    [K, N, ...], ``keys`` the [K] vector of per-lane batch keys — lane
+    ``l``'s mask is exactly ``seed_dropout(xs[l], keys[l], ...)``'s, so
+    packed-vs-vmap dropout parity is bit-exact per lane."""
+    if deterministic or rate <= 0.0:
+        return xs
+    if keys is None:
+        raise ValueError(
+            "lane_dropout: train-mode apply without the [K] lane key "
+            "vector (the joint form passes the member batch keys)")
+    return jax.vmap(
+        lambda x, k: seed_dropout(x, k, rate, layer, False))(xs, keys)
 
 
 # -- stacked-tree helpers (the packing contract, DESIGN.md §15) ---------------

@@ -138,10 +138,12 @@ def _make_mesh_finish(axis, client_transform, reduce_extras, server_update):
 
 def apply_server_and_rollback(variables0, agg, extras, total, server_state,
                               rng, server_update):
-    """The ONE post-aggregation tail every mesh round shares (plain,
-    grouped, and packed — parallel/packed.py): the server hook on
-    replicated values with the round's server key, then the elastic
-    all-failed rollback. Zero-count clients (failed/dropped, counts*live=0)
+    """The ONE post-aggregation tail every non-vmap round shares — the
+    mesh rounds (plain, grouped, and packed — parallel/packed.py) AND,
+    since packed-everywhere, the packed SIMULATION round
+    (FedAvgAPI.build_round_step_packed), which passes already-summed
+    (psum-free) values: the server hook on replicated values with the
+    round's server key, then the elastic all-failed rollback. Zero-count clients (failed/dropped, counts*live=0)
     contribute nothing to ``agg``; if EVERY client failed the round is a
     full no-op — weights AND server state roll back (matching the
     simulation paradigm's _finish_round guard), else the server optimizer

@@ -300,33 +300,82 @@ def make_lane_train(
 
 # --- fedpack: the joint (stacked-lane) execution form -----------------------
 
-_warned_fallback: set = set()
+# Fallback bookkeeping: warn-once keys plus a registry counter lane
+# ("packed" namespace) so pulse snapshots and trace_report surface WHICH
+# programs fell back, not just a one-shot process log line. State is
+# process-scoped but resettable: obs.reset() (the per-federation teardown
+# tests already call between runs) clears both, so a second federation in
+# one process re-warns and counts from zero instead of inheriting the
+# first federation's suppression.
+_FALLBACK_STATE: dict = {"seen": set(), "group": None}
+
+
+def _fallback_group():
+    g = _FALLBACK_STATE["group"]
+    if g is None:
+        from fedml_tpu.obs import default_registry
+
+        g = _FALLBACK_STATE["group"] = default_registry().group("packed")
+    return g
+
+
+def reset_fallback_warnings() -> None:
+    """Clear the warn-once set and drop the registry counter group (called
+    by obs.reset so fallback accounting is per-federation in tests/tools
+    that reset the plane between runs)."""
+    _FALLBACK_STATE["seen"].clear()
+    _FALLBACK_STATE["group"] = None
+
+
+def packed_fallback_reason(bundle: ModelBundle, packed_conv: str,
+                           optimizer: str = "sgd") -> Optional[str]:
+    """Why the joint form does NOT apply (None = it does). After the
+    packed-everywhere refactor the only remaining reasons are genuinely
+    unpackable shapes — the DESIGN.md §15 exception table:
+
+    - ``packed_conv=off`` (the flag, not a capability gap);
+    - the model family ships no lane-major packed twin
+      (``packed_variant is None`` — mixed per-lane architectures, rnn/
+      transformer/moe);
+    - the model uses flax-rng dropout and its packed twin does not opt in
+      to the explicit per-lane key stream (``explicit_dropout``).
+
+    Client optimizer choice no longer disqualifies: optimizer state is
+    held per-lane (``[L]``-leading leaves via a vmapped optax init/update),
+    so adam's scalar step count and friends reset and freeze per lane like
+    any other leaf. ``optimizer`` stays in the signature for call-site
+    symmetry and future optimizers with genuinely unliftable state."""
+    del optimizer
+    if packed_conv in (None, "", "off"):
+        return "packed_conv=off"
+    if bundle.packed_variant is None:
+        return f"model {bundle.name!r} has no packed conv variant"
+    if bundle.uses_dropout:
+        pb = bundle.packed_variant(packed_conv)
+        if not getattr(pb, "explicit_dropout", False):
+            return (f"model {bundle.name!r} uses flax-rng dropout and its "
+                    "packed twin has no explicit per-lane key stream")
+    return None
 
 
 def _packed_model_bundle(bundle: ModelBundle, packed_conv: str,
                          optimizer: str) -> Optional[ModelBundle]:
     """Resolve the fedpack joint-lane lowering: the packed twin bundle, or
-    None when the per-lane vmap must stay (flag off, model family without a
-    packed variant, dropout models — whose per-lane rng draws the joint
-    apply cannot replay — or an optimizer whose optax state carries leaves
-    without the lane axis, e.g. adam's scalar count, which the per-lane
-    reset logic cannot address)."""
-    if packed_conv in (None, "", "off"):
-        return None
-    reason = None
-    if bundle.packed_variant is None:
-        reason = f"model {bundle.name!r} has no packed conv variant"
-    elif bundle.uses_dropout:
-        reason = f"model {bundle.name!r} uses dropout (per-lane rng streams)"
-    elif optimizer.lower() != "sgd":
-        reason = (f"optimizer {optimizer!r} carries non-lane-shaped state; "
-                  "the joint form supports sgd(+momentum/wd)")
+    None when the per-lane vmap must stay (:func:`packed_fallback_reason`).
+    A real fallback (flag ON but joint form inapplicable) is warned once
+    per (model, lowering) and counted in the "packed" registry lane."""
+    reason = packed_fallback_reason(bundle, packed_conv, optimizer)
     if reason is not None:
-        key = (bundle.name, packed_conv, optimizer)
-        if key not in _warned_fallback:
-            _warned_fallback.add(key)
-            log.warning("packed_conv=%r falls back to the per-lane vmap: %s",
-                        packed_conv, reason)
+        if packed_conv not in (None, "", "off"):
+            g = _fallback_group()
+            ck = f"fallback:{bundle.name}:{packed_conv}"
+            g[ck] = g.get(ck, 0) + 1
+            key = (bundle.name, packed_conv, reason)
+            if key not in _FALLBACK_STATE["seen"]:
+                _FALLBACK_STATE["seen"].add(key)
+                log.warning(
+                    "packed_conv=%r falls back to the per-lane vmap: %s",
+                    packed_conv, reason)
         return None
     return bundle.packed_variant(packed_conv)
 
@@ -336,7 +385,7 @@ def packed_conv_active(bundle: ModelBundle, packed_conv: str,
     """Whether :func:`make_lanes_train` will use the fedpack joint form for
     this (bundle, flag, optimizer) — callers use it to attach fedcost
     packing hints only to programs that really carry the packed GEMMs."""
-    return _packed_model_bundle(bundle, packed_conv, optimizer) is not None
+    return packed_fallback_reason(bundle, packed_conv, optimizer) is None
 
 
 def make_lanes_train(
@@ -386,10 +435,27 @@ def make_packed_lanes_train(
     explicitly, so every conv lowers as one client-packed contraction
     (``packed_bundle``, ops/packed_conv.py) instead of K per-lane
     partial-lane GEMMs. Everything per-lane — replay tables, reset/freeze
-    masks, weighted accumulation, grad clipping — is computed with an
-    explicit [L] lane vector exactly as the vmap form computes it per lane,
-    so the two forms agree up to GEMM summation order (pinned by
-    tests/test_packed_conv.py).
+    masks, weighted accumulation, grad clipping, OPTIMIZER STATE — is
+    computed with an explicit [L] lane vector exactly as the vmap form
+    computes it per lane, so the two forms agree up to GEMM summation
+    order (pinned by tests/test_packed_conv.py and the per-paradigm pins
+    in tests/test_packed_everywhere.py).
+
+    Optimizer state is stacked per lane: ``vmap(tx.init)`` over the
+    stacked params gives every optax leaf — including adam/amsgrad's
+    scalar step count and adagrad/yogi accumulators — a leading ``[L]``
+    axis, and ``vmap(tx.update)`` keeps the update per-lane, so the
+    reset-at-client-boundary and dead-step-freeze masks address ALL state
+    uniformly. This is what lets every client optimizer the reference
+    library ships ride the packed convs instead of forcing the vmap
+    fallback.
+
+    Dropout models ride via the explicit per-lane key stream: the packed
+    twin opts in with ``explicit_dropout`` (ops/packed_conv.seed_dropout /
+    lane_dropout) and the joint form hands the model apply the whole
+    ``[L]`` vector of this step's member batch keys — lane ``l``'s mask
+    derives from exactly the key the vmap form's lane ``l`` consumes, so
+    the two lowerings draw bit-identical masks per lane.
 
     Same call signature as the vmapped lane program (variables unstacked;
     member/plan arrays carrying the leading lane axis) and the same stacked
@@ -417,7 +483,10 @@ def make_packed_lanes_train(
         L = slot.shape[0]
         stack0 = stack_variables(variables0, L)
         sparams0 = stack0["params"]
-        opt_state0 = tx_opt.init(sparams0)
+        # per-LANE optimizer state: vmap(init) gives every optax leaf a
+        # leading [L] axis (adam's scalar count becomes [L]), so the
+        # reset/freeze masks below address adaptive state per lane
+        opt_state0 = jax.vmap(tx_opt.init)(sparams0)
 
         # Exact replay of make_local_train_fn's per-epoch order and batch
         # keys, per (lane, member) — the SAME shared definition the vmap
@@ -436,7 +505,10 @@ def make_packed_lanes_train(
             def loss_fn(sp):
                 vars_in = dict(svars)
                 vars_in["params"] = sp
-                logits, new_vars = pb.apply_train(vars_in, bx, bkey_l[0])
+                # the FULL [L] key vector: explicit-dropout packed twins
+                # draw lane l's mask from bkey_l[l] — the very key the
+                # vmap form's lane l consumes (non-dropout twins ignore it)
+                logits, new_vars = pb.apply_train(vars_in, bx, bkey_l)
                 per_lane = jax.vmap(task.loss)(logits, by, bm)      # [L]
                 if prox_mu:
                     # per-LANE prox term, folded into per_lane so the
@@ -463,7 +535,11 @@ def make_packed_lanes_train(
                     1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
                 grads = jax.tree.map(
                     lambda g: g * bcast(scale, g).astype(g.dtype), grads)
-            updates, new_opt = tx_opt.update(grads, sopt, svars["params"])
+            # per-lane update mirrors the per-lane init: adaptive moments,
+            # step counts and accumulators advance lane-by-lane exactly as
+            # the vmap form's per-lane tx.update does
+            updates, new_opt = jax.vmap(tx_opt.update)(
+                grads, sopt, svars["params"])
             out_vars = dict(new_vars)
             out_vars["params"] = optax.apply_updates(
                 svars["params"], updates)
@@ -575,10 +651,14 @@ def make_packed_cohort_train(
     SHAPE: vmap of the lane program over all lanes.
 
     Returns ``packed_train(variables, tx, ty, tm, sampled_rows, weights_pos,
-    rng, plan_arrays) -> (acc_vars, acc_w, acc_loss, acc_tau)`` summed over
-    all lanes. Aggregate = ``acc_vars / acc_w`` (elastic-guarded by the
-    caller). ``packed_conv`` selects the fedpack conv lowering for the lane
-    axis (ops/packed_conv.py): 'off' keeps the per-lane vmap."""
+    rng, plan_arrays) -> (acc_vars, acc_w, acc_loss, acc_tau, extras)``
+    summed over all lanes. Aggregate = ``acc_vars / acc_w``
+    (elastic-guarded by the caller); ``extras`` is the summed
+    ``reduce_extras`` partial tree ({} when the hook is absent) — the sim
+    paradigm's counterpart of the mesh psum tail, so the full cross-silo
+    hook contract (FedOpt/FedNova/AGC/robust) rides the packed schedule in
+    BOTH paradigms. ``packed_conv`` selects the fedpack conv lowering for
+    the lane axis (ops/packed_conv.py): 'off' keeps the per-lane vmap."""
     del shape_key  # lane count and shapes come in via the arrays
     lanes_fn = make_lanes_train(bundle, task, n_pad,
                                 packed_conv=packed_conv, **lane_kwargs)
@@ -606,11 +686,66 @@ def make_packed_cohort_train(
         lanes = lanes_fn(variables, x_flat, y_flat, m_flat, tm,
                          member_row, member_keys, member_w, steps_real,
                          slot, epoch_a, sie, reset, emit, live)
-        acc_vars, acc_w, acc_loss, acc_tau, _extras = lanes
+        acc_vars, acc_w, acc_loss, acc_tau, extras = lanes
+        # extras: [L] stacked (vmap form) or singleton-axis (joint form) —
+        # sum(axis=0) reduces either to the cohort partial sums the
+        # server_update hook consumes
         return (jax.tree.map(lambda a: jnp.sum(a, axis=0), acc_vars),
-                jnp.sum(acc_w), jnp.sum(acc_loss), jnp.sum(acc_tau))
+                jnp.sum(acc_w), jnp.sum(acc_loss), jnp.sum(acc_tau),
+                jax.tree.map(lambda e: jnp.sum(e, axis=0), extras))
 
     return packed_train
+
+
+# --- masked lane freeze/exit (packed Silo early stopping) -------------------
+
+def plan_arrays_tuple(plan: PackPlan) -> tuple:
+    """The 9-array runtime tuple every packed round program takes, in the
+    one canonical order (slot, epoch, sie, reset, emit, live, member_pos,
+    member_valid, steps_real)."""
+    return (plan.slot, plan.epoch, plan.sie, plan.reset, plan.emit,
+            plan.live, plan.member_pos, plan.member_valid, plan.steps_real)
+
+
+def mask_plan_arrays(plan: PackPlan, member_active: np.ndarray) -> tuple:
+    """Masked plan arrays for per-client lane EXIT (Silo early stopping):
+    a member whose ``member_active[lane, k]`` is 0 becomes a STRUCTURAL
+    no-op — its steps run with ``live = 0`` (params/opt/stats frozen by
+    the existing dead-step masks), its ``emit``/``member_valid`` zero out
+    so it contributes nothing to the weighted aggregate, and ``reset`` is
+    suppressed so the lane carries frozen state through the dead span to
+    the next active member's reset. Shapes are UNCHANGED — the same
+    compiled program executes, no recompile, no vmap fallback; the dead
+    steps are the price of keeping the XLA program static (a re-pack
+    would reclaim them at one recompile per exit wave).
+
+    ``member_active``: [n_lanes, k_max] {0,1} per plan member."""
+    act_m = np.asarray(member_active, np.float32)
+    # each step's activity = its owning member's activity (dead lane-tail
+    # steps index slot 0 but already carry live == 0, so the product below
+    # cannot resurrect or kill them incorrectly)
+    step_act = np.take_along_axis(act_m, plan.slot.astype(np.int64), axis=1)
+    return (plan.slot, plan.epoch, plan.sie,
+            (plan.reset * step_act).astype(plan.reset.dtype),
+            (plan.emit * step_act).astype(plan.emit.dtype),
+            (plan.live * step_act).astype(plan.live.dtype),
+            plan.member_pos,
+            (plan.member_valid * act_m).astype(plan.member_valid.dtype),
+            plan.steps_real)
+
+
+def mesh_member_active(plan: PackPlan, n_devices: int,
+                       active_perm: np.ndarray) -> np.ndarray:
+    """Per-(lane, member) activity for the MESH plan, whose ``member_pos``
+    index LOCAL rows within each device's client block and whose lane axis
+    is device-major [D * lanes_dev]. ``active_perm``: per-client {0,1} in
+    plan (device-major perm) order."""
+    ap = np.asarray(active_perm, np.float32)
+    D = int(n_devices)
+    rows = ap.reshape(D, -1)                       # [D, clients_per_device]
+    lanes_dev = plan.n_lanes // D
+    dev = np.repeat(np.arange(D), lanes_dev)       # lane -> device
+    return rows[dev[:, None], plan.member_pos.astype(np.int64)]
 
 
 # --- cross-silo mesh form ---------------------------------------------------

@@ -219,7 +219,8 @@ def test_golden_flagship_round_program_table():
         plan.member_pos, plan.member_valid, plan.steps_real))
     tx, ty, tm, _tc = api._dev_train
     rep = cost.analyze_jitted(step, (
-        api.variables, tx, ty, tm, jnp.asarray(sampled, jnp.int32),
+        api.variables, api.server_state, tx, ty, tm,
+        jnp.asarray(sampled, jnp.int32),
         jnp.asarray(counts), jax.random.PRNGKey(0), plan_arrays))
     assert rep is not None
     s = rep["summary"]

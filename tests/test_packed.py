@@ -105,7 +105,7 @@ def test_packed_single_lane_replays_local_train_bit_exact():
         # sampled_rows maps cohort position 0 -> stack row ci; the packed
         # key for position 0 must be the key client ci consumes in the
         # cohort program, so pass a single-position rng stream via fold
-        acc, acc_w, acc_loss, acc_tau = jax.jit(packed)(
+        acc, acc_w, acc_loss, acc_tau, _extras = jax.jit(packed)(
             variables,
             jnp.asarray(ds.train_x), jnp.asarray(ds.train_y),
             jnp.asarray(ds.train_mask),
@@ -181,14 +181,32 @@ def test_packed_fedprox_carries_the_proximal_term():
     assert abs(hr["Test/Loss"][-1] - ha["Test/Loss"][-1]) > 1e-4
 
 
-def test_packed_falls_back_for_custom_aggregation(caplog):
+def test_packed_rides_adaptive_aggregation(caplog):
+    """Packed-everywhere: FedOpt's server optimizer rides the packed
+    schedule in the SIMULATION paradigm via the same hook contract the
+    mesh path uses (server state threaded through the packed round) — the
+    pre-refactor behavior (silent fall-back to the grouped schedule with a
+    warning) is the regression this now guards against."""
     from fedml_tpu.algorithms.fedopt import FedOptAPI
 
     ds = _ds()
-    api = FedOptAPI(ds, _cfg(pack_lanes=4, comm_round=2))
-    h = api.train()   # must run (grouped/bucketed fallback), with a warning
+    api = FedOptAPI(ds, _cfg(pack_lanes=4, comm_round=2,
+                             server_optimizer="adam", server_lr=0.05))
+    h = api.train()
     assert len(h["Test/Loss"]) == 2
-    assert any("pack_lanes" in r.message for r in caplog.records)
+    assert api._packed_steps, "packed round program must engage"
+    assert not any("pack_lanes" in r.message for r in caplog.records)
+    # the server moments advanced through the packed round
+    import jax
+
+    leaves = jax.tree.leaves(api.server_state)
+    assert leaves and any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
+    # and the packed run equals the plain (unpacked) run
+    ref = FedOptAPI(ds, _cfg(pack_lanes=0, bucket_quantum_batches=0,
+                             device_data="off", comm_round=2,
+                             server_optimizer="adam", server_lr=0.05))
+    hr = ref.train()
+    np.testing.assert_allclose(h["Test/Loss"], hr["Test/Loss"], rtol=2e-5)
 
 
 def test_crosssilo_packed_matches_sim(caplog):

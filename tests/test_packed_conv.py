@@ -253,15 +253,27 @@ def test_flag_off_bit_identical_to_default(conv_ds, vmap_run):
 
 
 def test_fallbacks_keep_vmap_lowering():
-    from fedml_tpu.parallel.packed import packed_conv_active
+    """Packed-everywhere: the only remaining fallback reasons are the
+    DESIGN.md §15 exception table — no packed twin, flax-rng dropout
+    without an explicit-key twin, or the flag itself. Client optimizer no
+    longer disqualifies (per-lane [L]-stacked optax state)."""
+    from fedml_tpu.parallel.packed import (packed_conv_active,
+                                           packed_fallback_reason)
 
     lr = create_model("lr", 4, input_shape=(6,))
     conv = create_model("resnet20", 4, input_shape=(8, 8, 3))
+    drop = create_model("cnn_dropout", 4)
     assert not packed_conv_active(lr, "blockdiag")       # no packed variant
+    assert "no packed conv variant" in packed_fallback_reason(lr, "blockdiag")
     assert not packed_conv_active(conv, "off")           # flag off
-    assert not packed_conv_active(conv, "blockdiag", "adam")  # scalar state
+    assert packed_fallback_reason(conv, "off") == "packed_conv=off"
+    # adaptive client optimizers ride the stacked per-lane state now
+    assert packed_conv_active(conv, "blockdiag", "adam")
+    assert packed_conv_active(conv, "blockdiag", "yogi")
     assert packed_conv_active(conv, "blockdiag")
     assert packed_conv_active(conv, "grouped", "sgd")
+    # explicit-key dropout twins pack; flax-rng dropout models do not
+    assert packed_conv_active(drop, "blockdiag")
     with pytest.raises(ValueError):
         _conv_cfg(packed_conv="bogus")
 
@@ -326,7 +338,8 @@ def test_packed_round_program_census_and_lifted_ceiling():
         plan.member_pos, plan.member_valid, plan.steps_real))
     tx, ty, tm, _tc = api._dev_train
     rep = cost.analyze_jitted(step, (
-        api.variables, tx, ty, tm, jnp.asarray(sampled, jnp.int32),
+        api.variables, api.server_state, tx, ty, tm,
+        jnp.asarray(sampled, jnp.int32),
         jnp.asarray(counts), jax.random.PRNGKey(0), plan_arrays))
     assert rep is not None
     cost.apply_packing(rep["ops"], hints["packing_factor"],

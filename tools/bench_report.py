@@ -67,6 +67,14 @@ METRICS = {
     "packed_lane_ceiling": (
         lambda j: (j.get("packed_conv") or {}).get("out_lane_ceiling"),
         "packed ceiling", True),
+    # packed-everywhere (ISSUE 12): the ADAPTIVE (FedOpt) packed round
+    # program's static ceiling — must track the sgd flagship's (the
+    # acceptance bar is >= 0.8). Absent on pre-ISSUE-12 artifacts (the
+    # chained .get()s return None; missing keys never flake the gate).
+    "packed_fedopt_ceiling": (
+        lambda j: ((j.get("packed_conv") or {}).get("fedopt") or {})
+        .get("out_lane_ceiling"),
+        "fedopt packed ceiling", True),
     # fedsketch distribution tails from the profiler block (ISSUE 10):
     # per-client p99 train-ms and the p99 rounds-behind staleness spread
     "p99_train_ms": (

@@ -134,9 +134,49 @@ def _conv_variant(mode, xf, w2, h, w):
     )(xp, w2)
 
 
-def packed_main():
-    """The H8 sweep: K x {vmap, blockdiag, grouped} at C = 16/32/64."""
+def _scan_opt(fn, tx, xs):
+    """Adaptive-optimizer packed-program probe body: each scan iteration
+    is one TRAIN step — conv loss grad wrt the stacked kernels, then a
+    per-LANE optax update (``vmap(tx.update)``, the same stacked-state
+    form parallel/packed.py's joint program uses) — so the timed program
+    carries the optimizer's [K]-stacked state exactly like the packed
+    round does. The kernel renormalizes each iteration so the carry stays
+    bounded across the scan (a timing probe, not a training recipe)."""
+    import optax
+
+    def make(n):
+        def step(carry, _):
+            w, opt = carry
+            g = jax.grad(lambda ww: jnp.sum(
+                (fn(xs, ww) ** 2).astype(jnp.float32)))(w)
+            upd, opt = jax.vmap(tx.update)(g, opt, w)
+            w = optax.apply_updates(w, upd)
+            w = (w / (jnp.max(jnp.abs(w)) + 1e-3)).astype(w.dtype)
+            return (w, opt), ()
+
+        def run(ws, opt0):
+            (w, _), _ = jax.lax.scan(step, (ws, opt0), None, length=n)
+            return w
+
+        return run
+
+    return make
+
+
+def packed_main(optimizer: str = "none"):
+    """The H8 sweep: K x {vmap, blockdiag, grouped} at C = 16/32/64.
+    With ``--optimizer`` (sgd/adam/adamw/adagrad/yogi) each row also times
+    the full TRAIN step — fwd + dgrad/wgrad + a per-lane stacked optax
+    update — the packed-everywhere (H9) probe for the adaptive-optimizer
+    packed programs, same two-point tunnel-cancelling protocol."""
     from fedml_tpu.ops import packed_conv as pc
+
+    tx = None
+    if optimizer not in ("", "none", "off"):
+        from fedml_tpu.parallel.local import make_optimizer
+
+        tx = make_optimizer(optimizer, 0.01,
+                            momentum=0.9 if optimizer == "sgd" else 0.0)
 
     rng = np.random.RandomState(0)
     results = {}
@@ -165,12 +205,17 @@ def packed_main():
 
                 us_t = _time(_scan(train, xs, ws), xs, ws)
                 row["us"][f"{name}_f+dgrad"] = round(us_t, 2)
+                if tx is not None:
+                    opt0 = jax.vmap(tx.init)(ws)
+                    us_o = _time(_scan_opt(fn, tx, xs), ws, opt0)
+                    row["us"][f"{name}_train+{optimizer}"] = round(us_o, 2)
             # streamed rate: what the MXU executes for blockdiag (K x useful)
             row["streamed_gflops_blockdiag"] = round(
                 useful * K / row["us"]["blockdiag"] * 1e-3, 1)
             results[tag] = row
             print(tag, json.dumps(row), flush=True)
     print(json.dumps({"mode": "packed", "iters": ITERS, "batch": BATCH,
+                      "optimizer": optimizer,
                       "device": str(jax.devices()[0]), "rows": results}))
 
 
@@ -230,7 +275,15 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--mode", choices=("lanes", "packed"),
                     default=os.environ.get("PROBE_MODE", "lanes"))
-    if ap.parse_args().mode == "packed":
-        packed_main()
+    ap.add_argument("--optimizer",
+                    choices=("none", "sgd", "adam", "adamw", "adagrad",
+                             "yogi"),
+                    default=os.environ.get("PROBE_OPT", "none"),
+                    help="packed mode: also time the full train step with "
+                         "a per-lane stacked optax update (packed-"
+                         "everywhere / H9 probe)")
+    args = ap.parse_args()
+    if args.mode == "packed":
+        packed_main(args.optimizer)
     else:
         main()
