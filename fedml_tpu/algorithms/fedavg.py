@@ -43,6 +43,19 @@ from fedml_tpu.parallel.local import (
 log = logging.getLogger(__name__)
 
 
+def _donation_quiet(jitted):
+    """Wrap a donate-argnums jitted step: CPU backends implement no buffer
+    donation and warn once per compiled shape — donation is a no-op there,
+    so the warning is noise shared by every donated round/chunk step."""
+    def step(*args):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return jitted(*args)
+
+    return step
+
+
 def _chunk_buckets(sorted_maxes, G: int, q: int, n_pad: int) -> list:
     """The ONE grouping core both bucket schedulers share (the sim paradigm's
     _round_groups over sorted client counts, the mesh paradigm's
@@ -117,8 +130,37 @@ class FedAvgAPI:
         # by the first host-path round when config.host_pipeline_depth > 0
         self._prefetcher = None
         self._donated_step = None
+        # fedsched cohort scheduler: the ONE owner of per-round sampling —
+        # uniform policy is bit-identical to the old sample_clients call by
+        # construction; profiler policies read boundary snapshots fed by
+        # run_round's notify (data/sched.py)
+        from fedml_tpu.data.sched import CohortScheduler
+
+        self._cohort_sched = CohortScheduler(
+            config.cohort_policy, config.seed,
+            dataset.num_clients
+            if config.client_num_in_total > dataset.num_clients
+            else config.client_num_in_total,
+            min(config.client_num_per_round, dataset.num_clients))
+        # streaming chunked host rounds (fedsched): compiled chunk programs,
+        # the chunk-indexed prefetcher, and the last round's stream stats
+        # (the O(1)-accumulator evidence tests and the bench read)
+        self._stream_steps: dict = {}
+        self._stream_pf = None
+        self._stream_finish_fn = None
+        self._stream_mode_memo: Optional[str] = None
+        self.stream_stats: Optional[dict] = None
         #: per-round stage timings for utils/metrics.round_stats (host path)
         self._stage_rows: deque = deque(maxlen=1024)
+        if self._dev_train is not None and config.stream_aggregate != "off":
+            # same explicit-ignore discipline as device_data/host-pipeline:
+            # the device-resident round aggregates inside its own program
+            # (no host buffering to stream away), so the flags are inert
+            log.warning(
+                "stream_aggregate=%r (and cohort_chunk) ignored: the "
+                "dataset is device-resident, so the whole-cohort round "
+                "program already aggregates in-program; streaming applies "
+                "to the host round path", config.stream_aggregate)
         if self._dev_train is not None:
             self._round_step_gather = timed_build(
                 self._program_name("gather_step"), ("full",),
@@ -659,12 +701,7 @@ class FedAvgAPI:
         The Silo client-active mask folds into ``live`` here, so every
         host-cohort/gather/grouped/packed schedule honors an exit the same
         way it honors an injected failure: weight zero."""
-        c = self.config
-        sampled = sample_clients(round_idx, self.dataset.num_clients
-                                 if c.client_num_in_total > self.dataset.num_clients
-                                 else c.client_num_in_total,
-                                 min(c.client_num_per_round, self.dataset.num_clients),
-                                 seed=c.seed)
+        sampled = self._cohort_sched.sample(round_idx)
         live = self._sample_failures(round_idx, len(sampled), record=record)
         if self._client_active is not None:
             av = self._client_active[sampled]
@@ -697,6 +734,24 @@ class FedAvgAPI:
                 ep = max(self.config.epochs, 1)
                 padded = round(pk.executed_slots / ep) * self.config.batch_size
                 return int(counts.sum()), int(padded)
+        if (self._dev_train is None and self._stream_mode() != "off"
+                and self._stream_packed_active()):
+            # streamed packed chunks: each chunk executes its own lane
+            # plan's slots — sum them, one epoch's share (as above)
+            from fedml_tpu.parallel.packed import plan_packing
+
+            c = self.config
+            ep = max(c.epochs, 1)
+            raw = np.asarray(self.dataset.train_counts, np.float64)[sampled]
+            padded = 0
+            for start, size in self._stream_chunk_spec(len(sampled)):
+                pk = plan_packing(
+                    raw[start:start + size], c.batch_size, c.epochs,
+                    c.pack_lanes,
+                    t_quantum=max(1, c.bucket_quantum_batches // 4))
+                if pk is not None:
+                    padded += round(pk.executed_slots / ep) * c.batch_size
+            return int(counts.sum()), int(padded)
         plan = self._round_groups(sampled, live) if self._dev_train is not None else None
         if plan is not None:
             padded = sum(s * b for s, b in plan[1])
@@ -811,18 +866,346 @@ class FedAvgAPI:
             jitted = timed_build(
                 self._program_name("donated_step"), ("donated",),
                 lambda: jax.jit(self._round_body, donate_argnums=(2, 3, 4)))
-
-            def step(*args):
-                with warnings.catch_warnings():
-                    # CPU backends implement no cohort-buffer donation and
-                    # warn once per compiled shape; donation is a no-op there
-                    warnings.filterwarnings(
-                        "ignore",
-                        message="Some donated buffers were not usable")
-                    return jitted(*args)
-
-            self._donated_step = step
+            self._donated_step = _donation_quiet(jitted)
         return self._donated_step
+
+    # -- streaming chunked host rounds (fedsched) ----------------------------
+
+    def _stream_mode(self) -> str:
+        """Effective streaming-aggregation mode for THIS API: the config
+        mode when the base round machinery applies, else "off" with one
+        warning — streaming folds a plain weighted mean, so a rewired
+        local trainer / round program / custom aggregate() keeps its batch
+        path (the same exception discipline as the packed schedule)."""
+        memo = self._stream_mode_memo
+        if memo is not None:
+            return memo
+        c = self.config
+        mode = c.stream_aggregate
+        if mode != "off" and (
+                type(self).aggregate is not FedAvgAPI.aggregate
+                or self.crosssilo_hooks() is not None
+                or type(self).build_local_train is not FedAvgAPI.build_local_train
+                or type(self).build_round_step is not FedAvgAPI.build_round_step):
+            log.warning(
+                "stream_aggregate=%r ignored: %s rewires aggregation (or "
+                "carries crosssilo hooks) or the round program, which the "
+                "streaming fold cannot mirror; using the batch path",
+                mode, type(self).__name__)
+            mode = "off"
+        self._stream_mode_memo = mode
+        return mode
+
+    def _stream_packed_active(self) -> bool:
+        """Whether streamed chunks ride the packed-lanes round program
+        (pack_lanes > 0): clients packed back-to-back in scan lanes, so a
+        chunk executes ~ceil(count/bs) real batches per client instead of
+        the shared bucket length."""
+        return self.config.pack_lanes > 0 and self._stream_mode() != "off"
+
+    def _counts_view(self, dtype) -> "np.ndarray":
+        """Cached float view of the population counts table: the streamed
+        chunk path indexes it once per sub-cohort and the pulse feed once
+        per round, so a million-client table is converted once per run,
+        not re-cast (~8 MB of memcpy) on every lookup."""
+        cache = getattr(self, "_counts_view_cache", None)
+        if cache is None:
+            cache = self._counts_view_cache = {}
+        src = self.dataset.train_counts
+        key = (id(src), np.dtype(dtype).name)
+        v = cache.get(key)
+        if v is None:
+            if any(k[0] != id(src) for k in cache):
+                cache.clear()    # dataset swapped: drop the old table's views
+            v = cache[key] = np.asarray(src, dtype)
+        return v
+
+    @property
+    def _stream_chunks_per_round(self) -> int:
+        c = self.config
+        cohort = min(c.client_num_per_round, self.dataset.num_clients)
+        if c.cohort_chunk <= 0 or c.cohort_chunk >= cohort:
+            return 1
+        return -(-cohort // c.cohort_chunk)
+
+    def _stream_chunk_spec(self, cohort_n: int) -> list:
+        """[(start, size)] half-open sub-cohort chunks in plan order."""
+        chunk = self.config.cohort_chunk
+        if chunk <= 0 or chunk >= cohort_n:
+            return [(0, cohort_n)]
+        return [(s, min(chunk, cohort_n - s))
+                for s in range(0, cohort_n, chunk)]
+
+    def _stream_chunk_inputs(self, round_idx: int, ci: int, pool=None,
+                             n_chunks: int = 0):
+        """Host-side inputs for ONE sub-cohort chunk — pure in
+        (seed, round_idx, ci) like _host_round_inputs: materialize just the
+        chunk's clients, trim to the ROUND's shared bucket (vmap chunks —
+        the packed program needs the full record axis for its canonical
+        replay tables), bf16-cast, zero failed clients' weights, and derive
+        the full-round-normalized aggregation weights the deterministic
+        fold needs (the total weight is known from the plan, so the fold
+        can use exactly tree_weighted_mean's normalize-first arithmetic)."""
+        from fedml_tpu.data.pipeline import materialize_cohort
+        from fedml_tpu.utils.dtypes import host_bf16_cast
+
+        sampled, live, bucket = self._round_plan(round_idx)
+        if ci == 0:
+            self._stash_plan(round_idx, sampled, live)
+        start, size = self._stream_chunk_spec(len(sampled))[ci]
+        packed = self._stream_packed_active()
+        cx, cy, cm, counts = materialize_cohort(
+            self.dataset, sampled[start:start + size], pool, n_chunks)
+        if bucket is not None and not packed:
+            cx, cy, cm = cx[:, :bucket], cy[:, :bucket], cm[:, :bucket]
+        cx = host_bf16_cast(np.asarray(cx), self.config.dtype)
+        counts = np.asarray(counts, np.float32)
+        w_full = self._counts_view(np.float32)[sampled]
+        if live is not None:
+            lv = np.asarray(live, np.float32)
+            counts = counts * lv[start:start + size]
+            w_full = w_full * lv
+        # f32 normalize-first, bit-matching tree_weighted_mean's
+        # w / max(sum(w), 1e-12): the weights are integer-valued f32, so
+        # the host sum is exact and order-free
+        denom = np.maximum(np.float32(w_full.sum()), np.float32(1e-12))
+        w_norm = (counts / denom).astype(np.float32)
+        return (cx, cy, cm, counts, w_norm), (len(sampled), start, size,
+                                              bucket)
+
+    def _stream_prefetch_build(self, gidx: int, pool):
+        """Background build for global chunk index ``gidx`` = round *
+        chunks_per_round + chunk — the CohortPrefetcher speculates over
+        this monotone sequence exactly as it does over rounds, so its
+        in-flight memory is depth x ONE CHUNK, never a whole cohort."""
+        from fedml_tpu.obs import tracer_if_sampled
+
+        C = self._stream_chunks_per_round
+        r, ci = divmod(gidx, C)
+        tr = tracer_if_sampled(0, r)
+        t0 = time.perf_counter()
+        if tr is None:
+            payload_np, meta = self._stream_chunk_inputs(
+                r, ci, pool, n_chunks=getattr(pool, "_max_workers", 0))
+            t1 = time.perf_counter()
+            payload = tuple(jax.device_put(a) for a in payload_np)
+            jax.block_until_ready(payload)
+        else:
+            with tr.span("materialize", cat="prefetch",
+                         args={"round": r, "chunk": ci}):
+                payload_np, meta = self._stream_chunk_inputs(
+                    r, ci, pool, n_chunks=getattr(pool, "_max_workers", 0))
+            t1 = time.perf_counter()
+            with tr.span("h2d", cat="prefetch",
+                         args={"round": r, "chunk": ci}):
+                payload = tuple(jax.device_put(a) for a in payload_np)
+                jax.block_until_ready(payload)
+        t2 = time.perf_counter()
+        return (payload, meta), {"materialize_ms": (t1 - t0) * 1e3,
+                                 "h2d_ms": (t2 - t1) * 1e3}
+
+    def _stream_prefetcher(self):
+        """Chunk-granular CohortPrefetcher for the streaming round path
+        (depth counts CHUNKS, so memory in flight is depth sub-cohorts)."""
+        c = self.config
+        if c.host_pipeline_depth <= 0:
+            return None
+        if self._stream_pf is None:
+            from fedml_tpu.data.pipeline import CohortPrefetcher
+
+            C = self._stream_chunks_per_round
+            self._stream_pf = CohortPrefetcher(
+                self._stream_prefetch_build, c.host_pipeline_depth,
+                workers=c.host_pipeline_workers,
+                max_round=(None if c.comm_round is None
+                           else c.comm_round * C),
+                name="stream-prefetch")
+        return self._stream_pf
+
+    def build_round_step_stream_chunk(self, cohort: int, start: int,
+                                      size: int):
+        """One sub-cohort's jitted streaming step: train the chunk under
+        the SAME vmap schedule as the batch round (per-client keys =
+        split(rng, cohort)[position] — identical per-client math), then
+        fold its normalize-first weighted sums into the running
+        accumulator. With ONE chunk this computes bit-for-bit
+        tree_weighted_mean + _finish_round's loss: the deterministic
+        streaming mode's bit-identity to batch aggregation is by
+        construction, not by tolerance."""
+        cohort_train = self._cohort_train
+
+        def chunk_step(variables, acc, acc_w, acc_loss, cx, cy, cm, counts,
+                       w_norm, rng):
+            keys = jax.random.split(rng, cohort)[start:start + size]
+            res = cohort_train(variables, cx, cy, cm, counts, keys)
+
+            def wadd(a, x):
+                wb = w_norm.reshape((-1,) + (1,) * (x.ndim - 1))
+                return a + jnp.sum(x.astype(jnp.float32) * wb, axis=0)
+
+            acc = jax.tree.map(wadd, acc, res.variables)
+            w = counts.astype(jnp.float32)
+            return (acc, acc_w + jnp.sum(w),
+                    acc_loss + jnp.sum(res.train_loss * w))
+
+        if not self.config.donate:
+            return jax.jit(chunk_step)
+        # donate the accumulator (replaced every chunk) and the chunk
+        # buffers (this step is their last consumer) — chunked memory
+        # stays flat instead of growing by in-flight chunks
+        return _donation_quiet(jax.jit(chunk_step, donate_argnums=(1, 4, 5, 6)))
+
+    def build_round_step_stream_packed(self, cohort: int, start: int,
+                                       size: int, shape_key: tuple):
+        """Packed-lanes variant of the streaming chunk step: the chunk's
+        clients pack back-to-back into scan lanes
+        (parallel/packed.make_packed_cohort_train over the materialized
+        chunk arrays, key_slice preserving the canonical per-client keys),
+        and the lane program's native weighted sums fold into the
+        accumulator — the MXU fast path bounded by the accumulator, not by
+        one program's cohort buffers."""
+        from fedml_tpu.parallel.packed import make_packed_cohort_train
+
+        c = self.config
+        n_pad = int(self.dataset.train_x.shape[1])
+        packed = make_packed_cohort_train(
+            self.bundle, self.task, n_pad, shape_key,
+            packed_conv=c.packed_conv, key_slice=(cohort, start),
+            **self._local_train_kwargs())
+        rows = jnp.arange(size, dtype=jnp.int32)
+
+        def chunk_step(variables, acc, acc_w, acc_loss, cx, cy, cm, counts,
+                       rng, plan_arrays):
+            a, w, l, _tau, _extras = packed(
+                variables, cx, cy, cm, rows, counts, rng, plan_arrays)
+            acc = jax.tree.map(
+                lambda s, p: s + p.astype(jnp.float32), acc, a)
+            return acc, acc_w + w.astype(jnp.float32), \
+                acc_loss + l.astype(jnp.float32)
+
+        if not self.config.donate:
+            return jax.jit(chunk_step)
+        return _donation_quiet(jax.jit(chunk_step, donate_argnums=(1, 4, 5, 6)))
+
+    def _stream_finish(self, packed: bool):
+        """Round-close for the streaming fold: elastic all-failed rollback
+        + weighted loss, mirroring _finish_round's arithmetic. The vmap
+        fold accumulates normalize-first sums (the aggregate IS acc); the
+        packed fold accumulates unnormalized lane sums (aggregate =
+        acc / acc_w, the packed round's own tail)."""
+        if self._stream_finish_fn is None:
+            @jax.jit
+            def finish_vmap(variables, acc, acc_w, acc_loss):
+                keep = acc_w > 0
+                new_vars = jax.tree.map(
+                    lambda a, v: jnp.where(keep, a.astype(v.dtype), v),
+                    acc, variables)
+                return new_vars, acc_loss / jnp.maximum(acc_w, 1e-12)
+
+            @jax.jit
+            def finish_packed(variables, acc, acc_w, acc_loss):
+                denom = jnp.maximum(acc_w, 1e-12)
+                keep = acc_w > 0
+                new_vars = jax.tree.map(
+                    lambda a, v: jnp.where(keep, (a / denom).astype(v.dtype),
+                                           v),
+                    acc, variables)
+                return new_vars, acc_loss / denom
+
+            self._stream_finish_fn = (finish_vmap, finish_packed)
+        return self._stream_finish_fn[1 if packed else 0]
+
+    def _run_streaming_round(self, round_idx: int):
+        """Execute one host round as streamed sub-cohort chunks: each chunk
+        materializes (prefetched when the pipeline is on), trains, and
+        folds into the running accumulator as it finishes on device —
+        server memory is ONE f32 model sum regardless of cohort size."""
+        c = self.config
+        rk = round_key(self.root_key, round_idx)
+        sampled, live, bucket = self._round_plan(round_idx, record=True)
+        self._stash_plan(round_idx, sampled, live)
+        spec = self._stream_chunk_spec(len(sampled))
+        C = len(spec)
+        cohort_n = len(sampled)
+        packed = self._stream_packed_active()
+        acc = jax.tree.map(lambda v: jnp.zeros(v.shape, jnp.float32),
+                           self.variables)
+        acc_w = jnp.zeros((), jnp.float32)
+        acc_loss = jnp.zeros((), jnp.float32)
+        pf = self._stream_prefetcher()
+        mat_ms = h2d_ms = wait_ms = compute_ms = 0.0
+        for ci, (start, size) in enumerate(spec):
+            if pf is not None:
+                (payload, meta), stages, w_ms = pf.pop(round_idx * C + ci)
+                mat_ms += stages["materialize_ms"]
+                h2d_ms += stages["h2d_ms"]
+                wait_ms += w_ms
+            else:
+                t0 = time.perf_counter()
+                payload, meta = self._stream_chunk_inputs(round_idx, ci)
+                dt = (time.perf_counter() - t0) * 1e3
+                mat_ms += dt
+                wait_ms += dt    # serial: the host stage is fully exposed
+            cx, cy, cm, counts, w_norm = payload
+            t0 = time.perf_counter()
+            if packed:
+                from fedml_tpu.parallel.packed import (plan_arrays_tuple,
+                                                       plan_packing)
+
+                raw = self._counts_view(np.float64)[
+                    sampled[start:start + size]]
+                plan = plan_packing(
+                    raw, c.batch_size, c.epochs, c.pack_lanes,
+                    t_quantum=max(1, c.bucket_quantum_batches // 4))
+                key = ("p", cohort_n, start, size, plan.shape_key)
+                step = self._lru_step(
+                    self._stream_steps, key,
+                    lambda: self.build_round_step_stream_packed(
+                        cohort_n, start, size, plan.shape_key),
+                    "stream_step")
+                acc, acc_w, acc_loss = step(
+                    self.variables, acc, acc_w, acc_loss, cx, cy, cm,
+                    jnp.asarray(counts), rk,
+                    tuple(jnp.asarray(a)
+                          for a in plan_arrays_tuple(plan)))
+            else:
+                key = ("v", cohort_n, start, size, meta[3])
+                step = self._lru_step(
+                    self._stream_steps, key,
+                    lambda: self.build_round_step_stream_chunk(
+                        cohort_n, start, size),
+                    "stream_step")
+                acc, acc_w, acc_loss = step(
+                    self.variables, acc, acc_w, acc_loss, cx, cy, cm,
+                    jnp.asarray(counts), jnp.asarray(w_norm), rk)
+            compute_ms += (time.perf_counter() - t0) * 1e3
+        self.variables, train_loss = self._stream_finish(packed)(
+            self.variables, acc, acc_w, acc_loss)
+        if not c.async_rounds:
+            train_loss = float(train_loss)
+        row = {"materialize_ms": mat_ms, "h2d_ms": h2d_ms,
+               "wait_ms": wait_ms, "round": round_idx,
+               "compute_ms": compute_ms}
+        self._stage_rows.append(row)
+        from fedml_tpu.obs import default_registry, tracer_if_sampled
+
+        default_registry().append_row("stage", row)
+        tr = tracer_if_sampled(0, round_idx)
+        if tr is not None:
+            tr.counter("host_stages", {
+                k: row[k] for k in
+                ("materialize_ms", "h2d_ms", "compute_ms", "wait_ms")},
+                args={"round": round_idx})
+        # the O(1)-memory evidence: the server-side round state is ONE f32
+        # model-shaped accumulator + two scalars, independent of cohort
+        self.stream_stats = {
+            "mode": c.stream_aggregate, "cohort": cohort_n, "chunks": C,
+            "chunk_clients": c.cohort_chunk if C > 1 else cohort_n,
+            "packed_lanes": c.pack_lanes if packed else 0,
+            "accumulator_bytes": int(sum(
+                int(np.prod(v.shape)) * 4
+                for v in jax.tree.leaves(self.variables)) + 8)}
+        return train_loss
 
     def _traced_device_step(self, path: str, round_idx: int, step, *args):
         """Run one device round program under a ``mesh_step`` span so the
@@ -847,6 +1230,10 @@ class FedAvgAPI:
         self._prefetcher = None
         if pf is not None:
             pf.close()
+        spf = self._stream_pf
+        self._stream_pf = None
+        if spf is not None:
+            spf.close()
 
     # -- driver --------------------------------------------------------------
 
@@ -871,8 +1258,12 @@ class FedAvgAPI:
 
         tr = tracer_if_sampled(0, round_idx)
         pulse = pulse_if_enabled()
+        sched = self._cohort_sched
         if tr is None and pulse is None:
-            return self._run_round_inner(round_idx)
+            out = self._run_round_inner(round_idx)
+            if sched.wants_notify:
+                sched.notify_round_done(round_idx)
+            return out
         t0 = time.perf_counter()
         if tr is None:
             out = self._run_round_inner(round_idx)
@@ -887,7 +1278,18 @@ class FedAvgAPI:
             # would force the sync the flag exists to avoid)
             pulse.on_sim_round(self, round_idx,
                                out, (time.perf_counter() - t0) * 1e3)
+        # fedsched boundary: snapshot the profiler AFTER this round's pulse
+        # feed, so the plan for round r + SCHED_LAG sees it
+        if sched.wants_notify:
+            sched.notify_round_done(round_idx)
         return out
+
+    def set_cohort_profiler(self, source) -> None:
+        """Freeze the fedsched scheduling signal to ``source`` (a
+        ClientProfiler or ProfileSnapshot; None clears): every plan then
+        derives from this one snapshot — timing- and pipeline-depth-
+        independent, the determinism mode tools/xdev_ab.py --policy pins."""
+        self._cohort_sched.set_static_profile(source)
 
     def _stash_plan(self, round_idx: int, sampled, live) -> None:
         """Record a computed round plan for :meth:`_pulse_cohort` (single
@@ -916,6 +1318,23 @@ class FedAvgAPI:
         if live is not None:
             ids = ids[np.asarray(live) > 0]
         return ids
+
+    def _pulse_cohort_shares(self, ids) -> "Optional[np.ndarray]":
+        """Per-client share of the round wall for the fedpulse profiler
+        feed: proportional to each client's record count — within a fused
+        cohort a client with 3x the records consumed ~3x the materialize +
+        compute, so count-weighted attribution is the honest amortization
+        (and the signal that lets the ``speed`` policy tell a heavy client
+        from a light one). None = even split (paradigms whose cohorts
+        don't map to the stacked count table override _pulse_cohort and
+        may not have counts for every id)."""
+        counts = self._counts_view(np.float64)
+        ids = np.asarray(ids, np.int64)
+        if ids.size == 0 or ids.max(initial=-1) >= counts.shape[0]:
+            return None
+        c = counts[ids]
+        total = float(c.sum())
+        return c / total if total > 0 else None
 
     def _run_round_inner(self, round_idx: int) -> "float | jax.Array":
         rk = round_key(self.root_key, round_idx)
@@ -956,6 +1375,12 @@ class FedAvgAPI:
                 jnp.asarray(sampled, jnp.int32), jnp.asarray(live_np), rk
             )
         else:
+            if self._stream_mode() != "off":
+                # fedsched streaming path: sub-cohort chunks fold into the
+                # running accumulator as they finish (O(1) server memory
+                # in cohort size); unchunked deterministic mode computes
+                # the batch program's arithmetic bit-for-bit
+                return self._run_streaming_round(round_idx)
             pf = self._host_prefetcher()
             if pf is not None:
                 # pipelined: the background build computes the full plan

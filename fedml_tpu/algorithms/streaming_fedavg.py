@@ -58,6 +58,24 @@ class StreamingFedAvgAPI(FedAvgAPI):
         self._batch_step = self._build_batch_step()
         self._opt_init = jax.jit(lambda p: self._opt_tx.init(p))
         self._finish_jit = jax.jit(self._finish_round)
+        self._stream_fold = None
+
+    def _stream_mode(self) -> str:
+        """This paradigm HAS no single round program to mirror — the base
+        gate's build_round_step check doesn't apply. Streaming folds the
+        plain weighted mean, so only a custom aggregate() opts out."""
+        memo = self._stream_mode_memo
+        if memo is not None:
+            return memo
+        mode = self.config.stream_aggregate
+        if mode != "off" and type(self).aggregate is not FedAvgAPI.aggregate:
+            log.warning(
+                "stream_aggregate=%r ignored: %s overrides aggregate(), "
+                "which the streaming fold cannot mirror", mode,
+                type(self).__name__)
+            mode = "off"
+        self._stream_mode_memo = mode
+        return mode
 
     def build_round_step(self):
         # rounds are driven batch-by-batch in run_round; there is no single
@@ -176,6 +194,50 @@ class StreamingFedAvgAPI(FedAvgAPI):
         tau = jnp.float32(c.epochs * steps_real)
         return variables, last_loss, tau
 
+    def _build_stream_fold(self):
+        """Device fold for --stream_aggregate: one client's result folds
+        into the running f32 accumulator (normalize-first weights — the
+        round total is known from the plan), so the round holds ONE
+        model-shaped sum instead of the O(cohort) stacked list."""
+        @jax.jit
+        def fold(acc, acc_loss, v, loss, w_norm, w):
+            acc = jax.tree.map(
+                lambda a, x: a + x.astype(jnp.float32) * w_norm, acc, v)
+            return acc, acc_loss + loss * w
+
+        return fold
+
+    def _run_round_streamed(self, round_idx, sampled, counts, keys, cohort):
+        """The sequential client loop with the streaming fold (O(1) server
+        memory); aggregation mirrors _finish_round's arithmetic at the
+        fedseg tolerance (per-client fold order vs one stacked sum)."""
+        if self._stream_fold is None:
+            self._stream_fold = self._build_stream_fold()
+        acc = jax.tree.map(lambda v: jnp.zeros(v.shape, jnp.float32),
+                           self.variables)
+        acc_loss = jnp.zeros(())
+        total = np.float32(counts.sum())
+        denom = np.maximum(total, np.float32(1e-12))
+        for i, k in enumerate(sampled):
+            if counts[i] <= 0:
+                continue   # zero weight: its term in the mean is exactly 0
+            data = None if cohort is None else cohort[i]
+            v, l, _tau = self._train_client_streaming(int(k), keys[i], data)
+            acc, acc_loss = self._stream_fold(
+                acc, acc_loss, v, l,
+                jnp.float32(counts[i] / denom), jnp.float32(counts[i]))
+        keep = total > 0
+        if keep:
+            self.variables = jax.tree.map(
+                lambda a, v: a.astype(v.dtype), acc, self.variables)
+        self.stream_stats = {
+            "mode": self.config.stream_aggregate, "cohort": len(sampled),
+            "chunks": len(sampled),
+            "accumulator_bytes": int(sum(
+                int(np.prod(v.shape)) * 4
+                for v in jax.tree.leaves(self.variables)) + 8)}
+        return acc_loss / jnp.maximum(jnp.float32(total), 1e-12)
+
     def _run_round_inner(self, round_idx: int):
         # traced via the base run_round wrapper (one "round" span per round)
         sampled, live, _bucket = self._round_plan(round_idx, record=True)
@@ -191,22 +253,27 @@ class StreamingFedAvgAPI(FedAvgAPI):
         if pf is not None:
             cohort, stages, wait_ms = pf.pop(round_idx)
         t0 = time.perf_counter()
-        for i, k in enumerate(sampled):
-            if counts[i] <= 0:
-                # failed client: zero aggregation weight — its (skipped)
-                # training result cannot influence the round, so train a
-                # placeholder from the current globals for tree shape only
-                outs.append(self.variables)
-                losses.append(jnp.zeros(()))
-                taus.append(jnp.zeros(()))
-                continue
-            # prefetched rows exist exactly for live positions (the
-            # counts[i] > 0 guard above matches the build's live filter)
-            data = None if cohort is None else cohort[i]
-            v, l, tau = self._train_client_streaming(int(k), keys[i], data)
-            outs.append(v)
-            losses.append(l)
-            taus.append(tau)
+        streamed = None
+        if self._stream_mode() != "off":
+            streamed = self._run_round_streamed(
+                round_idx, sampled, counts, keys, cohort)
+        else:
+            for i, k in enumerate(sampled):
+                if counts[i] <= 0:
+                    # failed client: zero aggregation weight — its (skipped)
+                    # training result cannot influence the round, so train a
+                    # placeholder from the current globals for tree shape only
+                    outs.append(self.variables)
+                    losses.append(jnp.zeros(()))
+                    taus.append(jnp.zeros(()))
+                    continue
+                # prefetched rows exist exactly for live positions (the
+                # counts[i] > 0 guard above matches the build's live filter)
+                data = None if cohort is None else cohort[i]
+                v, l, tau = self._train_client_streaming(int(k), keys[i], data)
+                outs.append(v)
+                losses.append(l)
+                taus.append(tau)
         if stages is not None:
             row = dict(stages, wait_ms=wait_ms, round=round_idx,
                        compute_ms=(time.perf_counter() - t0) * 1e3)
@@ -214,6 +281,9 @@ class StreamingFedAvgAPI(FedAvgAPI):
             from fedml_tpu.obs import default_registry
 
             default_registry().append_row("stage", row)
+        if streamed is not None:
+            return (streamed if self.config.async_rounds
+                    else float(streamed))
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
         res = LocalResult(stacked, jnp.stack(losses), jnp.stack(taus))
         self.variables, self.server_state, train_loss = self._finish_jit(

@@ -212,6 +212,38 @@ class FedConfig:
     # one prefetched round (per-client RNG streams are independent, so the
     # parallel materialization is bit-identical to serial). 0 = auto.
     host_pipeline_workers: int = 0
+    # fedsched cohort-selection policy (data/sched.py): how the round's
+    # cohort is drawn from the client population. "uniform" (default) is
+    # today's deterministic draw, bit-identical by construction. "speed"
+    # packs cohorts from the fedpulse ClientProfiler's observed EMA
+    # train-ms (an oversampled uniform pool, keep the fastest) so one slow
+    # client no longer gates the round; "fair" is speed packing with a
+    # fixed fraction of the cohort reserved for the least-participated
+    # candidates. Profiler-driven policies are pure in (seed, round,
+    # profiler-snapshot-at-schedule-time); with no profiler (pulse plane
+    # off) they schedule uniform cold-starts and warn once.
+    cohort_policy: str = "uniform"
+    # Streaming server-side aggregation (core/streaming.py + the chunked
+    # host round path): fold each client contribution into a running
+    # weighted accumulator instead of buffering the whole cohort — O(1)
+    # memory in cohort size. "off" (default) keeps today's batch
+    # aggregation, bit-identical. "deterministic" folds in the fixed plan
+    # order (chunk order on the sim path, worker-index order on the edge
+    # via hold-and-fold) so results are independent of arrival timing;
+    # unchunked it is bit-identical to batch aggregation by construction.
+    # "arrival" folds strictly on arrival (the O(1)-strict edge mode);
+    # numerics match batch within the fedseg tolerance (float summation
+    # order only).
+    stream_aggregate: str = "off"
+    # Sub-cohort chunk size for the streaming host round path: the sampled
+    # cohort materializes, ships and trains in chunks of this many clients,
+    # each folded into the streaming accumulator as it finishes — cohort
+    # size is bounded by the accumulator (one model copy), not by one
+    # jitted program's buffers, which is what thousand-client cohorts
+    # need. 0 = whole cohort in one program. Requires stream_aggregate on.
+    # With pack_lanes > 0 each chunk rides the packed-lanes round program
+    # (clients packed back-to-back in scan lanes — the MXU fast path).
+    cohort_chunk: int = 0
     # Cohort execution schedule: 0 (default) trains the whole sampled cohort
     # under one vmap — per-client convs fuse into ONE grouped convolution
     # (feature_group_count = cohort), which XLA's TPU lowering expands
@@ -328,6 +360,22 @@ class FedConfig:
             raise ValueError(
                 f"packed_conv must be off|blockdiag|grouped, got "
                 f"{self.packed_conv!r}")
+        if self.cohort_policy not in ("uniform", "speed", "fair"):
+            raise ValueError(
+                f"cohort_policy must be uniform|speed|fair, got "
+                f"{self.cohort_policy!r}")
+        if self.stream_aggregate not in ("off", "deterministic", "arrival"):
+            raise ValueError(
+                f"stream_aggregate must be off|deterministic|arrival, got "
+                f"{self.stream_aggregate!r}")
+        if self.cohort_chunk < 0:
+            raise ValueError(
+                f"cohort_chunk must be >= 0, got {self.cohort_chunk}")
+        if self.cohort_chunk > 0 and self.stream_aggregate == "off":
+            raise ValueError(
+                "cohort_chunk > 0 needs stream_aggregate: sub-cohort chunks "
+                "only exist to be folded into the streaming accumulator — "
+                "set --stream_aggregate deterministic (or arrival)")
         if self.rounds_per_step < 1:
             raise ValueError(
                 f"rounds_per_step must be >= 1, got {self.rounds_per_step}")
@@ -519,6 +567,23 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                    default=defaults.host_pipeline_workers,
                    help="threads fanning one cohort's materialization out "
                         "over its clients (0 = auto)")
+    p.add_argument("--cohort_policy", type=str,
+                   default=defaults.cohort_policy,
+                   choices=("uniform", "speed", "fair"),
+                   help="fedsched cohort selection: uniform draw (default, "
+                        "bit-identical), speed packing from the profiler's "
+                        "EMA train-ms, or fairness-bounded speed packing")
+    p.add_argument("--stream_aggregate", type=str,
+                   default=defaults.stream_aggregate,
+                   choices=("off", "deterministic", "arrival"),
+                   help="streaming server-side aggregation: fold client "
+                        "updates into a running weighted accumulator (O(1) "
+                        "memory in cohort size) in fixed plan order "
+                        "(deterministic) or strictly on arrival")
+    p.add_argument("--cohort_chunk", type=int, default=defaults.cohort_chunk,
+                   help="stream the host round in sub-cohorts of this many "
+                        "clients through the accumulator (0 = whole cohort; "
+                        "requires --stream_aggregate)")
     p.add_argument("--scan_unroll", type=int, default=defaults.scan_unroll)
     p.add_argument("--cohort_vmap_width", type=int,
                    default=defaults.cohort_vmap_width)

@@ -1,0 +1,136 @@
+"""Streaming server-side aggregation: fold contributions as they arrive.
+
+The batch aggregation paths buffer the whole cohort before averaging — the
+edge server's ``model_dict`` holds every worker's model tree, the sim
+paradigm stacks the cohort's results inside one program. Both are O(cohort)
+in memory, which is exactly the bound thousand-client cohorts must escape.
+This module is the O(1) replacement: a running weighted accumulator (ONE
+model-shaped sum + a scalar weight) each contribution folds into.
+
+Two fold orders, selected by ``--stream_aggregate``:
+
+- ``deterministic``: contributions fold in their CANONICAL index order
+  (worker index on the edge, chunk order on the sim path). Out-of-order
+  arrivals are held until their predecessors fold — the held set is empty
+  whenever arrivals are in order, and bounded by the worker count in the
+  worst case (``peak_held`` measures it). The aggregate is a pure function
+  of the contribution SET — independent of arrival timing, retransmits,
+  chaos reordering, or pipeline depth.
+- ``arrival``: fold strictly on arrival — O(1) held state always. The
+  aggregate depends on arrival order only through float summation order
+  (pinned at the fedseg tolerance by tests/test_fedsched.py).
+
+The accumulator sums in float64 and divides once at :meth:`finalize`, so
+a long fold cannot drift the way repeated float32 re-normalization would;
+the result is cast back to each leaf's dtype. Zero-weight contributions
+(rejoin catch-ups, failed clients) fold as no-ops — identical to their
+zero-weight term in the batch weighted mean.
+
+The sim paradigm's chunked round path does its folding ON DEVICE inside
+jitted chunk programs (algorithms/fedavg.py), and the sequential-client
+``StreamingFedAvgAPI`` builds its own jitted device fold — this host-side
+class serves the EDGE aggregator (StreamingFedAVGAggregator) and carries
+the measured ``nbytes`` the O(1)-memory test pins.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["StreamAccumulator"]
+
+Pytree = Any
+
+
+def _tree_map(fn, *trees):
+    import jax
+
+    return jax.tree.map(fn, *trees)
+
+
+class StreamAccumulator:
+    """Running weighted accumulator over pytree contributions (module
+    docstring). Thread-safe: the edge server's handler thread feeds it."""
+
+    def __init__(self, mode: str = "deterministic"):
+        if mode not in ("deterministic", "arrival"):
+            raise ValueError(
+                f"stream mode must be deterministic|arrival, got {mode!r}")
+        self.mode = mode
+        self._lock = threading.Lock()
+        self._acc: Optional[Pytree] = None      # float64 leaf sums
+        self._acc_w = 0.0
+        self._next = 0                          # deterministic: fold frontier
+        self._held: dict[int, tuple] = {}       # deterministic out-of-order
+        self.folded = 0
+        #: high-water mark of simultaneously held contributions — the
+        #: measured evidence the O(1) pin reads (0 for in-order feeds)
+        self.peak_held = 0
+
+    def _fold(self, tree: Pytree, weight: float) -> None:
+        if weight:
+            scaled = _tree_map(
+                lambda x: np.asarray(x, np.float64) * weight, tree)
+            if self._acc is None:
+                self._acc = scaled
+            else:
+                self._acc = _tree_map(np.add, self._acc, scaled)
+            self._acc_w += weight
+        elif self._acc is None:
+            # remember the tree SHAPE so an all-zero-weight round can still
+            # finalize to the elastic no-op without a template guess
+            self._acc = _tree_map(
+                lambda x: np.zeros(np.shape(x), np.float64), tree)
+        self.folded += 1
+
+    def add(self, index: int, tree: Pytree, weight: float) -> None:
+        """Fold contribution ``index`` (its canonical position: worker
+        index, chunk index) with aggregation ``weight``."""
+        weight = float(weight)
+        with self._lock:
+            if self.mode == "arrival":
+                self._fold(tree, weight)
+                return
+            self._held[int(index)] = (tree, weight)
+            self.peak_held = max(self.peak_held, len(self._held))
+            while self._next in self._held:
+                t, w = self._held.pop(self._next)
+                self._fold(t, w)
+                self._next += 1
+
+    def finalize(self, template: Pytree) -> Optional[Pytree]:
+        """Close the round: drain any still-held contributions in index
+        order (workers the deadline dropped leave gaps — the survivors
+        fold in THEIR index order, still arrival-independent), then return
+        the weighted mean cast to ``template``'s leaf dtypes — or ``None``
+        for a zero-weight round (the caller's elastic no-op)."""
+        with self._lock:
+            for i in sorted(self._held):
+                t, w = self._held.pop(i)
+                self._fold(t, w)
+            if self._acc is None or self._acc_w <= 0.0:
+                return None
+            inv = 1.0 / self._acc_w
+            return _tree_map(
+                lambda a, t: (a * inv).astype(np.asarray(t).dtype),
+                self._acc, template)
+
+    @property
+    def nbytes(self) -> int:
+        """Measured accumulator footprint: the float64 running sum plus
+        whatever is currently held — ONE model copy plus the (normally
+        empty) out-of-order buffer, independent of how many contributions
+        have folded."""
+        import jax
+
+        total = 0
+        if self._acc is not None:
+            total += sum(np.asarray(leaf).nbytes
+                         for leaf in jax.tree.leaves(self._acc))
+        for t, _w in self._held.values():
+            total += sum(np.asarray(leaf).nbytes
+                         for leaf in jax.tree.leaves(t))
+        return int(total)

@@ -1,0 +1,295 @@
+"""fedsched: profiler-driven cohort scheduling for cross-device rounds.
+
+Every paradigm samples its round cohort uniformly (core/rng.sample_clients,
+the reference's ``np.random.seed(round_idx)`` draw). At cross-device scale
+that leaves the round gated by whichever slow client the draw happened to
+include — FedML Parrot (arXiv:2303.01778, PAPERS.md) names
+heterogeneity-aware cohort scheduling as the unlock, and the fedpulse
+:class:`~fedml_tpu.obs.profile.ClientProfiler` was built to supply exactly
+the signal it needs (``speed_rank`` / ``participation_fairness``). This
+module is the consumer: a pluggable cohort-selection policy sitting where
+``sample_clients`` used to be called.
+
+Policies
+--------
+- ``uniform``: literally today's draw — :func:`plan_cohort` calls
+  ``sample_clients`` with the same arguments, so the default is
+  bit-identical to the pre-scheduler path by construction.
+- ``speed``: draw an oversampled candidate pool uniformly (the same
+  deterministic stream), then keep the ``cohort`` candidates with the
+  LOWEST observed EMA train-ms — cohorts pack speed-homogeneous, so one
+  slow client no longer gates the round. Candidates the profiler has never
+  seen (cold starts, and ids dropped at the profiler's ``max_clients``
+  cap) rank at the SEEN population's median EMA: they mix into the middle
+  instead of being starved (or worse, raising) — the ISSUE's dropped-id
+  contract.
+- ``fair``: speed packing with a participation bound — a fixed fraction of
+  the cohort is reserved for the LEAST-participated candidates (unseen
+  clients count as participation 0, so exploration never stops), the rest
+  filled fastest-first. The reservation keeps the participation gini from
+  running away the way pure ``speed`` lets it.
+
+Determinism contract
+--------------------
+:func:`plan_cohort` is PURE in ``(seed, round_idx, snapshot)``: the same
+profiler snapshot yields the same plan, byte for byte — so the PR-3
+``CohortPrefetcher`` can keep speculating (whoever computes a round's plan
+first, consumer or background build, gets the identical answer) and a
+static snapshot (tools/xdev_ab.py ``--policy``) makes whole runs replay
+bit-identically at any pipeline depth. Live-fed snapshots are captured at
+round boundaries with a fixed :data:`SCHED_LAG` (the plan for round ``r``
+uses the newest snapshot taken at or before round ``r - SCHED_LAG``), and
+every computed plan lands in a bounded ledger — within a run, re-requests
+(the bench re-running rounds, checkpoint-restore jumps, ``round_counts``)
+replay the ledger, never a fresher snapshot.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, NamedTuple, Optional
+
+import numpy as np
+
+from fedml_tpu.core.rng import sample_clients
+
+log = logging.getLogger(__name__)
+
+__all__ = ["COHORT_POLICIES", "SCHED_LAG", "CohortScheduler",
+           "ProfileSnapshot", "plan_cohort", "snapshot_from_counts"]
+
+COHORT_POLICIES = ("uniform", "speed", "fair")
+
+#: rounds between a snapshot and the first plan allowed to use it. A plan
+#: for round r reads the snapshot taken after round r - SCHED_LAG, so a
+#: prefetcher speculating up to SCHED_LAG - 1 rounds ahead schedules from
+#: the same snapshot the serial path would — deeper speculation falls back
+#: to the newest snapshot available at build time (still pure per plan via
+#: the ledger, but no longer depth-independent; xdev_ab's determinism arm
+#: uses a static snapshot, which is depth-independent at ANY depth).
+SCHED_LAG = 2
+
+#: candidate pool size as a multiple of the cohort for the profiler-driven
+#: policies — big enough to skip the slow tail, small enough that the pool
+#: stays a uniform draw over the population
+OVERSAMPLE = 4
+
+#: ``fair``: fraction of the cohort reserved for least-participated
+#: candidates (>= 1 slot)
+FAIR_FRACTION = 0.25
+
+
+class ProfileSnapshot(NamedTuple):
+    """Immutable view of a :class:`ClientProfiler` at one schedule point:
+    ``ids`` are the SEEN client ids ascending, the other arrays align."""
+
+    ids: np.ndarray            # [n_seen] int64, sorted ascending
+    ema_train_ms: np.ndarray   # [n_seen] float32
+    participation: np.ndarray  # [n_seen] int32
+
+    @property
+    def n_seen(self) -> int:
+        return int(self.ids.size)
+
+
+def _lookup(snap: ProfileSnapshot, pool: np.ndarray):
+    """Per-candidate (seen, ema, participation) against the snapshot.
+    Candidates outside the snapshot — cold starts, ids beyond the
+    profiler's ``max_clients`` cap — come back ``seen=False``; nothing
+    here can raise on an arbitrary id."""
+    idx = np.searchsorted(snap.ids, pool)
+    idx_c = np.clip(idx, 0, max(snap.n_seen - 1, 0))
+    seen = (idx < snap.n_seen) & (snap.ids[idx_c] == pool)
+    ema = np.where(seen, snap.ema_train_ms[idx_c], np.nan)
+    part = np.where(seen, snap.participation[idx_c], 0).astype(np.int64)
+    return seen, ema, part
+
+
+def snapshot_from_counts(counts, ms_per_record: float = 1.0,
+                         participation=None) -> ProfileSnapshot:
+    """Population-wide snapshot from per-client record COUNTS: expected
+    train-ms = ``counts * ms_per_record``. This is the cold-start prior a
+    cross-device deployment actually has — every client reports its
+    dataset size at registration (the reference wires ``sample_num`` into
+    every upload), while OBSERVED train-ms exists only for clients a
+    cohort has already run. At a million-client population a uniformly
+    drawn candidate pool almost never intersects the few thousand ids the
+    live profiler has seen, so ``speed``/``fair`` would degenerate to the
+    cold-start middle; extrapolating the profiler's measured per-record
+    cost over the counts table (the bench fits ``ms_per_record`` =
+    median(EMA/records) over the seen ids) gives the policies a total
+    signal. Deterministic by construction — counts are dataset metadata."""
+    counts = np.asarray(counts, np.float64)
+    n = counts.shape[0]
+    part = (np.zeros(n, np.int32) if participation is None
+            else np.asarray(participation, np.int32))
+    return ProfileSnapshot(
+        ids=np.arange(n, dtype=np.int64),
+        ema_train_ms=(counts * float(ms_per_record)).astype(np.float32),
+        participation=part)
+
+
+def plan_cohort(round_idx: int, client_num_in_total: int, cohort: int,
+                seed: int, policy: str = "uniform",
+                snapshot: Optional[ProfileSnapshot] = None) -> np.ndarray:
+    """The pure planning function (module docstring). Returns the sampled
+    cohort's client ids, sorted ascending like ``sample_clients``."""
+    if policy not in COHORT_POLICIES:
+        raise ValueError(
+            f"cohort_policy must be one of {COHORT_POLICIES}, got {policy!r}")
+    if (policy == "uniform" or snapshot is None or snapshot.n_seen == 0
+            or cohort >= client_num_in_total):
+        # cold start (and the full-participation degenerate case): the
+        # uniform draw IS the plan — bit-identical to the unscheduled path
+        return sample_clients(round_idx, client_num_in_total, cohort,
+                              seed=seed)
+    pool = sample_clients(round_idx, client_num_in_total,
+                          min(client_num_in_total, cohort * OVERSAMPLE),
+                          seed=seed)
+    seen, ema, part = _lookup(snapshot, pool)
+    # cold-start candidates rank at the median SEEN speed: they mix into
+    # the middle of the pool instead of being pinned fastest (which would
+    # thrash cohorts with unprofiled clients) or slowest (which would
+    # starve them of the observations the ranking needs)
+    fill = float(np.median(snapshot.ema_train_ms))
+    key = np.where(seen, ema, np.float32(fill))
+    if policy == "speed":
+        order = np.argsort(key, kind="stable")   # ties keep pool (id) order
+        pick = pool[order[:cohort]]
+    else:  # fair
+        reserve = max(1, int(round(FAIR_FRACTION * cohort)))
+        by_part = np.argsort(part, kind="stable")
+        reserved = by_part[:reserve]
+        taken = np.zeros(pool.size, bool)
+        taken[reserved] = True
+        by_speed = np.argsort(key, kind="stable")
+        rest = by_speed[~taken[by_speed]][: cohort - reserve]
+        pick = pool[np.concatenate([reserved, rest])]
+    return np.sort(pick).astype(np.int64)
+
+
+class CohortScheduler:
+    """Stateful wrapper: snapshot capture at round boundaries + the plan
+    ledger. Thread-safe — the prefetcher's background builds and the
+    consuming round may both ask for (and therefore compute) plans."""
+
+    #: ledger bound: covers every realistic replay window (pipeline depth,
+    #: bench re-runs, restore jumps); evicted plans recompute from the
+    #: snapshot store, which only holds the recent boundary snapshots
+    LEDGER_CAP = 4096
+
+    def __init__(self, policy: str, seed: int, client_num_in_total: int,
+                 cohort: int,
+                 profile_source: Optional[Callable] = None,
+                 lag: int = SCHED_LAG):
+        if policy not in COHORT_POLICIES:
+            raise ValueError(
+                f"cohort_policy must be one of {COHORT_POLICIES}, got "
+                f"{policy!r}")
+        self.policy = policy
+        self.seed = int(seed)
+        self.client_num_in_total = int(client_num_in_total)
+        self.cohort = int(cohort)
+        self.lag = int(lag)
+        #: () -> ClientProfiler | None; default: the live fedpulse profiler
+        self.profile_source = profile_source or _live_profiler
+        self._lock = threading.Lock()
+        self._plans: dict[int, np.ndarray] = {}
+        #: [(round, snapshot)] ascending, bounded — the live capture store
+        self._snaps: list[tuple[int, ProfileSnapshot]] = []
+        self._static: Optional[ProfileSnapshot] = None
+        self._warned_no_signal = False
+
+    # -- feeds ---------------------------------------------------------------
+
+    @property
+    def wants_notify(self) -> bool:
+        """Whether the consumer should call :meth:`notify_round_done` —
+        only the live-fed profiler policies need boundary snapshots."""
+        return self.policy != "uniform" and self._static is None
+
+    def set_static_profile(self, source) -> None:
+        """Freeze the scheduling signal: ``source`` is a ProfileSnapshot or
+        a ClientProfiler (snapshotted once, NOW). Every plan then derives
+        from this one snapshot — timing- and pipeline-depth-independent,
+        the xdev_ab determinism arm's mode. ``None`` clears it."""
+        if source is None:
+            snap = None
+        elif isinstance(source, ProfileSnapshot):
+            snap = source
+        else:
+            snap = source.snapshot()
+        with self._lock:
+            self._static = snap
+            self._plans.clear()
+
+    def notify_round_done(self, round_idx: int) -> None:
+        """Round boundary: capture the live profiler snapshot labeled
+        ``round_idx`` (no-op for uniform / static modes)."""
+        if not self.wants_notify:
+            return
+        profiler = self.profile_source()
+        if profiler is None:
+            return
+        snap = profiler.snapshot()
+        with self._lock:
+            if self._snaps and self._snaps[-1][0] >= round_idx:
+                # bench re-runs / restore jumps revisit old rounds; the
+                # snapshot store stays monotone so _snapshot_for's
+                # "newest at or before r - lag" is well defined
+                return
+            self._snaps.append((int(round_idx), snap))
+            del self._snaps[:-max(self.lag + 6, 8)]
+
+    # -- queries -------------------------------------------------------------
+
+    def _snapshot_for(self, round_idx: int) -> Optional[ProfileSnapshot]:
+        if self._static is not None:
+            return self._static
+        target = round_idx - self.lag
+        best = None
+        for r, snap in self._snaps:
+            if r <= target:
+                # newest at or before the lag target; a background build
+                # speculating deeper than the completed rounds naturally
+                # lands on the newest snapshot available at build time —
+                # the ledger then makes whichever snapshot won sticky
+                best = snap
+            else:
+                break
+        return best
+
+    def sample(self, round_idx: int) -> np.ndarray:
+        """The round's cohort plan (ledger-memoized; see module contract)."""
+        r = int(round_idx)
+        with self._lock:
+            plan = self._plans.get(r)
+            if plan is None:
+                snap = self._snapshot_for(r)
+                if (snap is None and self.policy != "uniform"
+                        and not self._warned_no_signal
+                        and self.profile_source() is None
+                        and self._static is None):
+                    log.warning(
+                        "cohort_policy=%r has no profiler signal (pulse "
+                        "plane off and no static profile); scheduling "
+                        "uniform cold-starts until one appears", self.policy)
+                    self._warned_no_signal = True
+                plan = plan_cohort(r, self.client_num_in_total, self.cohort,
+                                   self.seed, self.policy, snap)
+                if len(self._plans) >= self.LEDGER_CAP:
+                    self._plans.pop(next(iter(self._plans)))
+                self._plans[r] = plan
+            else:
+                self._plans[r] = self._plans.pop(r)   # LRU refresh
+        return plan
+
+
+def _live_profiler():
+    """Default profile source: the fedpulse plane's ClientProfiler (None
+    while the plane is off — the scheduler then runs uniform cold-start)."""
+    from fedml_tpu.obs.live import pulse_if_enabled
+
+    plane = pulse_if_enabled()
+    return plane.profiler if plane is not None else None

@@ -93,6 +93,12 @@ class FedAVGAggregator:
         # (uploads_accepted == rounds x workers under full participation)
         self.uploads_accepted = 0
         self._eval = make_eval_fn(bundle, get_task(dataset.task, dataset.class_num)) if bundle is not None and dataset is not None else None
+        if getattr(config, "cohort_policy", "uniform") != "uniform":
+            LOG.warning(
+                "cohort_policy=%r ignored on the edge paradigm: the server "
+                "samples uniformly (client_sampling/sample_clients); "
+                "profiler-scheduled cohorts are a sim-path feature today",
+                config.cohort_policy)
 
     def get_global_model_params(self):
         return self.variables
@@ -135,6 +141,79 @@ class FedAVGAggregator:
         m["round"] = round_idx
         self.test_history.append(m)
         return m
+
+
+class StreamingFedAVGAggregator(FedAVGAggregator):
+    """O(1)-memory server aggregation (core/streaming.StreamAccumulator):
+    each accepted upload folds into ONE running weighted model sum the
+    moment it arrives, instead of buffering every worker's tree in
+    ``model_dict`` until the round closes — the memory bound a
+    thousand-worker federation needs. ``model_dict`` keeps index->None
+    markers so the deadline machinery's received-set logic (and the
+    ``uploads`` count) is unchanged.
+
+    Mode (``--stream_aggregate``): ``deterministic`` folds in worker-index
+    order (out-of-order arrivals held until their predecessors land —
+    empty in-order, bounded by the worker count worst-case), so the
+    aggregate is independent of arrival timing, retransmit storms and
+    chaos reordering; ``arrival`` folds immediately (strict O(1) held
+    state) and matches batch within the fedseg tolerance. Stale uploads
+    are dropped by the server manager BEFORE they reach this class, and a
+    second same-round upload from one worker is dropped (first wins,
+    counted) — nothing can fold twice."""
+
+    def __init__(self, variables, worker_num: int, config, dataset=None,
+                 bundle=None):
+        super().__init__(variables, worker_num, config, dataset=dataset,
+                         bundle=bundle)
+        from fedml_tpu.core.streaming import StreamAccumulator
+
+        mode = getattr(config, "stream_aggregate", "deterministic")
+        self._stream_cls = lambda: StreamAccumulator(
+            "arrival" if mode == "arrival" else "deterministic")
+        self._stream = self._stream_cls()
+        #: same-round duplicate uploads dropped (the batch path overwrote;
+        #: a fold cannot be un-applied, so first wins — surfaced, never
+        #: silently double-aggregated)
+        self.duplicate_uploads = 0
+        #: high-water mark of simultaneously held out-of-order uploads
+        #: (deterministic mode) — the measured O(1) evidence
+        self.stream_peak_held = 0
+
+    @property
+    def stream_nbytes(self) -> int:
+        return self._stream.nbytes
+
+    def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
+        if index in self.model_dict:
+            self.duplicate_uploads += 1
+            return
+        self._stream.add(index, model_params, float(sample_num))
+        self.stream_peak_held = max(self.stream_peak_held,
+                                    self._stream.peak_held)
+        self.model_dict[index] = None
+        self.sample_num_dict[index] = float(sample_num)
+        self.flag_client_model_uploaded_dict[index] = True
+        self.uploads_accepted += 1
+
+    def aggregate(self):
+        out = self._stream.finalize(self.variables)
+        self._stream = self._stream_cls()
+        self.model_dict.clear()
+        if out is not None:
+            self.variables = out
+        # None = zero-weight round: the elastic no-op, like the batch path
+        return self.variables
+
+
+def make_aggregator(variables, worker_num: int, config, dataset=None,
+                    bundle=None) -> FedAVGAggregator:
+    """Batch or streaming server aggregation per ``config.stream_aggregate``
+    — the one switch every edge launcher routes through."""
+    cls = (StreamingFedAVGAggregator
+           if getattr(config, "stream_aggregate", "off") != "off"
+           else FedAVGAggregator)
+    return cls(variables, worker_num, config, dataset=dataset, bundle=bundle)
 
 
 class FedAvgEdgeServerManager(ServerManager):
@@ -813,7 +892,7 @@ def build_edge_rank(dataset, config, rank: int, world_size: int, comm,
     args = _edge_args(config, dataset)
     if rank == 0:
         if aggregator is None:
-            aggregator = FedAVGAggregator(
+            aggregator = make_aggregator(
                 bundle.init(root_key), world_size - 1, config,
                 dataset=dataset, bundle=bundle,
             )
@@ -835,8 +914,9 @@ def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = Tru
     bundle = create_model(config.model, dataset.class_num, input_shape=dataset.train_x.shape[2:] or None)
     root_key = seed_everything(config.seed)
     size = worker_num + 1
-    aggregator = FedAVGAggregator(
-        bundle.init(root_key), worker_num, config, dataset=dataset, bundle=bundle
+    aggregator = make_aggregator(
+        bundle.init(root_key), worker_num, config, dataset=dataset,
+        bundle=bundle
     )
 
     def make(rank, comm):

@@ -224,7 +224,18 @@ class PulsePlane:
         try:
             ids = api._pulse_cohort(round_idx)
             if ids is not None and ids.size:
-                train_ms = round_ms / float(ids.size)
+                # amortize the round wall by each client's share of the
+                # round's RECORDS when the API can attribute it
+                # (_pulse_cohort_shares): a 3x-records client consumed ~3x
+                # the materialize + compute, and this is the per-client
+                # cost signal the fedsched `speed` policy ranks on. Even
+                # split when shares are unavailable.
+                shares = getattr(api, "_pulse_cohort_shares",
+                                 lambda _ids: None)(ids)
+                if shares is None:
+                    train_ms = round_ms / float(ids.size)
+                else:
+                    train_ms = np.asarray(shares, np.float64) * round_ms
         except Exception:
             # a paradigm whose dataset/plan doesn't fit the cohort contract
             # (vertical splits etc.): keep the round snapshot, skip per-client

@@ -221,6 +221,22 @@ class ClientProfiler:
             return {lane: self.sketches[lane].copy()
                     for lane in SKETCH_LANES if self.sketches[lane].n}
 
+    def snapshot(self):
+        """Immutable schedule-time view for the fedsched cohort scheduler
+        (data/sched.ProfileSnapshot): seen ids ascending + their EMA
+        train-ms and participation counts, copied under the lock. Ids the
+        cap dropped are — by construction — absent, so a scheduler holding
+        this snapshot treats them as unseen cold-starts, never an index
+        error."""
+        from fedml_tpu.data.sched import ProfileSnapshot
+
+        with self._lock:
+            ids = self._seen_ids()
+            return ProfileSnapshot(
+                ids=ids.astype(np.int64),
+                ema_train_ms=self._ema_train_ms[ids].copy(),
+                participation=self._participation[ids].copy())
+
     @property
     def clients_seen(self) -> int:
         return int((self._participation[: self._n] > 0).sum())

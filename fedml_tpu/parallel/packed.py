@@ -645,10 +645,18 @@ def make_packed_cohort_train(
     *,
     compute_dtype=None,
     packed_conv: str = "off",
+    key_slice: Optional[tuple] = None,
     **lane_kwargs,
 ) -> Callable:
     """Build the packed-cohort program (simulation paradigm) for one plan
     SHAPE: vmap of the lane program over all lanes.
+
+    ``key_slice=(cohort_total, start)`` derives per-position keys as
+    ``split(rng, cohort_total)[start:start + len(rows)]`` instead of
+    ``split(rng, len(rows))`` — the streamed sub-cohort chunks (fedsched)
+    use it so every client consumes the SAME per-round key it would under
+    the whole-cohort program, keeping the canonical-replay contract intact
+    across chunk boundaries.
 
     Returns ``packed_train(variables, tx, ty, tm, sampled_rows, weights_pos,
     rng, plan_arrays) -> (acc_vars, acc_w, acc_loss, acc_tau, extras)``
@@ -678,7 +686,12 @@ def make_packed_cohort_train(
         x_flat = tx.reshape((C * n_pad,) + tx.shape[2:])
         y_flat = ty.reshape((C * n_pad,) + ty.shape[2:])
         m_flat = tm.reshape((C * n_pad,))
-        keys_full = jax.random.split(rng, sampled_rows.shape[0])
+        if key_slice is None:
+            keys_full = jax.random.split(rng, sampled_rows.shape[0])
+        else:
+            total, start = key_slice
+            keys_full = jax.random.split(rng, total)[
+                start:start + sampled_rows.shape[0]]
         member_row = sampled_rows[member_pos]      # [n_lanes, k_max]
         member_keys = keys_full[member_pos]
         member_w = weights_pos[member_pos] * member_valid

@@ -58,7 +58,7 @@ def test_committed_trajectory_values():
     """Pin the parsed trajectory itself: the committed series IS the
     baseline the gate compares future artifacts against."""
     rows = br.load_series(COMMITTED)
-    assert [r["n"] for r in rows] == [1, 2, 3, 4, 5]
+    assert [r["n"] for r in rows] == [1, 2, 3, 4, 5, 6]
     traj = {r["n"]: r for r in rows}
     assert traj[1]["vs_baseline"] == pytest.approx(1.6)
     assert traj[1]["mfu"] is None          # mfu starts at r02
@@ -66,12 +66,24 @@ def test_committed_trajectory_values():
     assert traj[5]["mfu"] == pytest.approx(0.1046)
     assert traj[5]["clients_per_sec"] == pytest.approx(46.83)
     assert traj[4]["crosssilo_img_per_sec"] == pytest.approx(30466.5)
+    # r06 (fedsched, ISSUE 13): 1M-client scheduled streaming block on a
+    # NEW host basis (1-core CPU container; r01-r05's host is gone) — the
+    # fedsched context columns appear and the basis stamp starts the new
+    # gated lineage
+    assert traj[6]["xdev_cohort"] == pytest.approx(1000)
+    assert traj[6]["xdev_policy"] == "speed"
+    assert traj[6]["clients_per_sec"] > 46.83   # above r05 despite 1 core
+    assert traj[6]["_basis"] is not None and traj[5]["_basis"] is None
+    assert traj[5]["xdev_cohort"] == pytest.approx(50)  # key predates r06
 
 
 def _regressed_copy(tmp_path, metric_mutator):
-    """Copy the committed artifacts, mutate r05's bench line."""
+    """Copy the LEGACY-lineage artifacts (r01-r05, no host_basis stamp) and
+    mutate r05's bench line — r06+ run on a different basis, so including
+    them would re-base the last pair and absorb the injected drop."""
     for p in COMMITTED:
-        shutil.copy(p, tmp_path / os.path.basename(p))
+        if int(os.path.basename(p)[7:9]) <= 5:
+            shutil.copy(p, tmp_path / os.path.basename(p))
     p5 = tmp_path / "BENCH_r05.json"
     art = json.loads(p5.read_text())
     lines = art["tail"].splitlines()
@@ -147,8 +159,9 @@ def test_tail_last_json_line_wins(tmp_path):
 
 
 def test_missing_metric_never_pairs_across_gaps():
-    """clients_per_sec exists only in r05 — one point, no comparison, no
-    spurious regression."""
+    """Metrics that appear mid-series (mfu at r02, clients_per_sec at r05)
+    never pair across their gaps, and the r05->r06 host-basis break
+    re-bases instead of regressing — the committed series gates clean."""
     rows = br.load_series(COMMITTED)
     regs = br.detect_regressions(rows, threshold=0.10)
     assert regs == []
@@ -157,17 +170,21 @@ def test_missing_metric_never_pairs_across_gaps():
 # -- fedsketch trajectory columns (ISSUE 10 satellite) ----------------------
 
 def test_sketch_columns_render_dash_on_presketch_artifacts(capsys):
-    """r01-r05 predate the profiler sketch block: the p99 train-ms and
-    staleness columns render '-' (missing-key tolerant) and the committed
-    series still gates clean."""
+    """r01-r05 predate the profiler sketch block AND the fedsched columns:
+    p99 train-ms / staleness / cohort-policy all render '-' (missing-key
+    tolerant), r06 fills the policy column, and the committed series still
+    gates clean."""
     rc = br.main(COMMITTED)
     out = capsys.readouterr()
     assert rc == 0
     assert "p99 train-ms" in out.out and "p99 staleness" in out.out
+    assert "cohort size" in out.out and "policy" in out.out
     header, *rows = [l for l in out.out.splitlines() if l.strip()]
     for row in rows:
-        if row.lstrip().startswith("r0"):
-            assert row.rstrip().endswith("-")      # staleness column empty
+        if row.lstrip().startswith("r06"):
+            assert row.rstrip().endswith("speed")  # the fedsched arm
+        elif row.lstrip().startswith("r0"):
+            assert row.rstrip().endswith("-")      # policy column empty
 
 
 def test_sketch_columns_parse_and_never_gate(tmp_path, capsys):
@@ -221,3 +238,41 @@ def test_t1_report_parses_obs_overhead_line(tmp_path, capsys):
     rep2 = mod.parse_log("....\n========= 4 passed in 1s =========\n")
     assert rep2["obs_overhead"] is None
     assert "obs-overhead" not in mod.format_report(rep2)
+
+
+# -- host_basis re-basing (ISSUE 13 satellite) ------------------------------
+
+def _series_with_bases(tmp_path, *specs):
+    """Write a minimal artifact per (n, value, host_basis) spec."""
+    paths = []
+    for n, value, basis in specs:
+        bench = {"metric": "x", "value": value, "vs_baseline": value / 10}
+        if basis is not None:
+            bench["host_basis"] = basis
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps({"n": n, "tail": json.dumps(bench)}))
+        paths.append(str(p))
+    return paths
+
+
+def test_host_basis_change_rebases_instead_of_regressing(tmp_path, capsys):
+    """A bench captured on a different container (r01-r05's host no longer
+    exists) must RE-BASE the trajectory, not read as a 90% regression; the
+    break is noted on stderr and the table still renders both runs."""
+    big = {"device": "TFRT_CPU_0", "cpus": 64, "model": "resnet56"}
+    small = {"device": "TFRT_CPU_0", "cpus": 1, "model": "lr"}
+    paths = _series_with_bases(tmp_path, (1, 1000.0, big), (2, 50.0, small))
+    rc = br.main(paths)
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "re-based" in out.err and "REGRESSION" not in out.err
+    # legacy artifacts (no stamp at all) keep gating against each other
+    paths = _series_with_bases(tmp_path, (1, 1000.0, None), (2, 50.0, None))
+    rc = br.main(paths)
+    out = capsys.readouterr()
+    assert rc == 1 and "REGRESSION" in out.err
+    # ...and so do two runs on the SAME stamped basis
+    paths = _series_with_bases(tmp_path, (1, 1000.0, small), (2, 50.0, small))
+    rc = br.main(paths)
+    out = capsys.readouterr()
+    assert rc == 1 and "REGRESSION" in out.err

@@ -739,6 +739,56 @@ def test_fedtop_tail_resets_on_truncated_stream(tmp_path):
     assert fedtop.stream_signature(str(tmp_path / "missing")) is None
 
 
+def test_fedtop_pulsetail_buffers_torn_line_until_newline(tmp_path):
+    """The live tail's torn-line regression (ISSUE 13): a partial trailing
+    JSON line is BUFFERED in memory until its newline arrives — each byte
+    is read from disk once (offset + buffer, no per-poll re-read of the
+    growing partial line), the snapshot parses exactly once no matter how
+    many polls the write spans, and truncation/rotation mid-line surfaces
+    ``reset=True`` so the live loop drops the dead run's history instead
+    of mixing two runs."""
+    fedtop = _load_tool("fedtop")
+    p = tmp_path / "pulse.jsonl"
+    line1 = json.dumps({"v": 1, "ts_ms": 1, "round": 0, "source": "x"}) + "\n"
+    line2 = json.dumps({"v": 1, "ts_ms": 2, "round": 1, "source": "x"}) + "\n"
+    p.write_bytes(line1.encode())
+    tail = fedtop.PulseTail(str(p))
+    snaps, reset = tail.poll()
+    assert [s["round"] for s in snaps] == [0] and not reset
+    # append line2 one byte per poll: every partial poll yields nothing,
+    # consumes nothing (offset pinned at the last complete line), and
+    # grows only the in-memory buffer; the newline byte completes it
+    for i in range(1, len(line2)):
+        p.write_bytes((line1 + line2[:i]).encode())
+        snaps, reset = tail.poll()
+        assert snaps == [] and not reset
+        assert tail.offset == len(line1) and tail.buf == line2[:i].encode()
+    p.write_bytes((line1 + line2).encode())
+    snaps, reset = tail.poll()
+    assert [s["round"] for s in snaps] == [1] and not reset
+    assert tail.offset == len(line1 + line2) and tail.buf == b""
+    # a quiet poll reads nothing and changes nothing
+    assert tail.poll() == ([], False)
+    # in-place truncation (same inode) mid-buffer: reset surfaces so the
+    # caller clears history; the new run's snapshots come back clean
+    p.write_bytes(line1.encode()[: len(line1) - 4])
+    snaps, reset = tail.poll()
+    assert snaps == [] and reset and tail.buf
+    p.write_bytes(line1.encode())
+    snaps, reset = tail.poll()
+    assert [s["round"] for s in snaps] == [0]
+    # rotation by replacement (new inode): reset again, fresh parse
+    q = tmp_path / "next.jsonl"
+    q.write_bytes(line2.encode())
+    os.replace(str(q), str(p))
+    snaps, reset = tail.poll()
+    assert [s["round"] for s in snaps] == [1] and reset
+    # a vanished file reports OSError-quietly: nothing, no crash
+    os.unlink(str(p))
+    snaps, reset = tail.poll()
+    assert snaps == [] and reset
+
+
 # -- the ISSUE 10 acceptance pin: 10k-cohort overhead budget ----------------
 
 #: the acceptance budget: full plane on within this fraction of plane-off

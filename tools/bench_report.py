@@ -20,6 +20,14 @@ metric is present. Trajectory-only columns (the fedsketch p99 train-ms /
 staleness tails, which are lower-is-better) render in the table but never
 feed the gate.
 
+Since r06 every artifact carries a ``host_basis`` stamp (device, cpu
+count, flagship model): throughput is only comparable on the same basis,
+so the gate pairs consecutive artifacts ONLY when their bases match — a
+bench captured on a different container re-bases the trajectory (noted on
+stderr, exit 0) instead of reading as a 16,000x "regression". Artifacts
+without the stamp (r01-r05) form their own legacy lineage and keep gating
+against each other.
+
 Exit codes: 0 trajectory clean; 1 regression(s) detected (listed on
 stderr); 2 nothing to analyze — no artifacts, or none parseable.
 """
@@ -81,6 +89,17 @@ METRICS = {
         lambda j: _sketch(j, "train_ms", "p99"), "p99 train-ms", False),
     "p99_staleness": (
         lambda j: _sketch(j, "staleness", "p99"), "p99 staleness", False),
+    # fedsched (ISSUE 13): the cross-device block's cohort size and cohort
+    # policy — context columns for the clients/s trajectory (the r06 jump
+    # reads as "1000-client scheduled cohorts", not as free speed). Absent
+    # on r01-r05 artifacts; `policy` is a STRING column (trajectory-only —
+    # strings never reach the drop gate).
+    "xdev_cohort": (
+        lambda j: (j.get("crossdevice") or {}).get("clients_per_round"),
+        "cohort size", False),
+    "xdev_policy": (
+        lambda j: (j.get("crossdevice") or {}).get("policy"),
+        "policy", False),
 }
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -131,27 +150,43 @@ def load_series(paths: list[str]) -> list[dict]:
             continue
         n, bench = parsed
         row = {"n": n, "path": os.path.basename(p)}
+        # the comparability stamp (None on pre-r06 artifacts — the legacy
+        # lineage); kept off the METRICS table, used only by the gate
+        hb = bench.get("host_basis")
+        row["_basis"] = (json.dumps(hb, sort_keys=True)
+                         if isinstance(hb, dict) else None)
         for key, (fn, _label, _gated) in METRICS.items():
             try:
                 v = fn(bench)
             except Exception:
                 v = None
-            row[key] = float(v) if isinstance(v, (int, float)) else None
+            # numbers feed the gate; strings (e.g. the policy column) are
+            # trajectory-only annotations; anything else renders as absent
+            row[key] = (float(v) if isinstance(v, (int, float))
+                        else v if isinstance(v, str) else None)
         rows.append(row)
     rows.sort(key=lambda r: r["n"])
     return rows
 
 
 def detect_regressions(rows: list[dict], threshold: float) -> list[str]:
-    """Last-present vs previous-present comparison per metric."""
+    """Last-present vs previous-present comparison per metric, paired only
+    within one host basis (module docstring). Returns regressions; basis
+    breaks are reported as notes on stderr, never as failures."""
     regressions = []
+    rebased = set()
     for key, (_fn, label, gated) in METRICS.items():
         if not gated:
             continue
-        present = [(r["n"], r[key]) for r in rows if r[key] is not None]
+        present = [(r["n"], r[key], r.get("_basis")) for r in rows
+                   if isinstance(r[key], float)]
         if len(present) < 2:
             continue
-        (prev_n, prev), (last_n, last) = present[-2], present[-1]
+        (prev_n, prev, prev_b), (last_n, last, last_b) = \
+            present[-2], present[-1]
+        if prev_b != last_b:
+            rebased.add((prev_n, last_n))
+            continue
         if prev <= 0:
             continue
         drop = 1.0 - last / prev
@@ -159,6 +194,10 @@ def detect_regressions(rows: list[dict], threshold: float) -> list[str]:
             regressions.append(
                 f"{label}: r{last_n:02d} {last:g} is {drop:.1%} below "
                 f"r{prev_n:02d} {prev:g} (threshold {threshold:.0%})")
+    for prev_n, last_n in sorted(rebased):
+        print(f"bench_report: r{last_n:02d} runs on a different host basis "
+              f"than r{prev_n:02d} — trajectory re-based, not gated",
+              file=sys.stderr)
     return regressions
 
 
@@ -170,12 +209,13 @@ def format_table(rows: list[dict]) -> str:
         cells = [f"r{r['n']:02d}"]
         for key in METRICS:
             v = r[key]
-            cells.append("-" if v is None else f"{v:g}")
+            cells.append("-" if v is None
+                         else v if isinstance(v, str) else f"{v:g}")
         out.append("  ".join(c.rjust(w) for c, w in zip(cells, widths)))
-    # per-metric delta line: last vs previous present value
+    # per-metric delta line: last vs previous present value (numeric only)
     deltas = ["delta"]
     for key in METRICS:
-        present = [r[key] for r in rows if r[key] is not None]
+        present = [r[key] for r in rows if isinstance(r[key], float)]
         if len(present) < 2 or present[-2] == 0:
             deltas.append("-")
         else:

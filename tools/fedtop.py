@@ -34,17 +34,35 @@ import sys
 import time
 
 
+def _parse_complete_lines(data: bytes) -> list[dict]:
+    """The JSONL contract over COMPLETE (newline-terminated) bytes: skip
+    blanks and unparseable lines, keep round-carrying dicts."""
+    snaps: list[dict] = []
+    for line in data.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snap = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(snap, dict) and "round" in snap:
+            snaps.append(snap)
+    return snaps
+
+
 def read_snapshots(path: str, offset: int = 0) -> tuple[list[dict], int]:
     """Parse snapshots from byte ``offset`` on; returns (snaps, new offset).
 
-    Two writer races are guarded here so live tailing can never wedge or
+    Two writer races are guarded here so one-shot reads can never wedge or
     tear a snapshot: a TRAILING TORN LINE (the reader catching the
     ``O_APPEND`` writer mid-write — the kernel may expose a prefix of one
     ``os.write``) is left un-consumed for the next poll (``offset`` only
     ever advances past complete newline-terminated lines), and a file that
     SHRANK below our offset (a new run truncating/rotating the stream)
     resets the tail to the start instead of seeking past EOF and reading
-    empty forever."""
+    empty forever. The LIVE tail uses :class:`PulseTail`, which buffers
+    the torn bytes instead of re-reading them every poll."""
     snaps: list[dict] = []
     try:
         with open(path, "rb") as f:
@@ -56,17 +74,59 @@ def read_snapshots(path: str, offset: int = 0) -> tuple[list[dict], int]:
     except OSError:
         return snaps, offset
     end = data.rfind(b"\n") + 1
-    for line in data[:end].splitlines():
-        line = line.strip()
-        if not line:
-            continue
+    return _parse_complete_lines(data[:end]), offset + end
+
+
+class PulseTail:
+    """Incremental live tail with the torn-line buffer the deferred
+    (re-read-from-offset) scheme lacked.
+
+    ``read_snapshots`` defers a torn trailing line by NOT advancing its
+    offset — correct, but the live loop then re-reads the same partial
+    bytes from disk on every poll (quadratic on a snapshot line growing
+    across polls: big federations emit multi-hundred-KB snapshots in
+    several kernel writes), and its in-read truncation reset could not
+    tell the CALLER, so a run that truncated the stream in place (same
+    inode) had its fresh snapshots appended onto the dead run's history.
+    This tail reads each byte ONCE: complete lines are consumed (offset
+    advances), the partial trailing line is buffered in memory until its
+    newline arrives, and every reset — rotation by replacement (inode
+    change) or in-place truncation (size below consumed+buffered) — is
+    surfaced as ``reset=True`` so the caller can drop stale history."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0        # bytes consumed: complete lines only
+        self.buf = b""         # torn trailing line, buffered until newline
+        self.sig = stream_signature(path)
+
+    def poll(self) -> tuple[list[dict], bool]:
+        """-> (new snapshots, reset). ``reset=True`` means the stream was
+        replaced or truncated and any history the caller holds describes
+        a previous run."""
+        reset = False
+        sig = stream_signature(self.path)
+        if sig != self.sig:
+            self.sig, self.offset, self.buf = sig, 0, b""
+            reset = True
         try:
-            snap = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(snap, dict) and "round" in snap:
-            snaps.append(snap)
-    return snaps, offset + end
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() < self.offset + len(self.buf):
+                    # truncated in place (same inode): restart from the top
+                    self.offset, self.buf = 0, b""
+                    reset = True
+                f.seek(self.offset + len(self.buf))
+                data = f.read()
+        except OSError:
+            return [], reset
+        if not data and not reset:
+            return [], False
+        combined = self.buf + data
+        end = combined.rfind(b"\n") + 1
+        self.offset += end
+        self.buf = combined[end:]
+        return _parse_complete_lines(combined[:end]), reset
 
 
 def stream_signature(path: str):
@@ -224,7 +284,8 @@ def main(argv=None) -> int:
         return 1 if state == "critical" else 0
 
     last_new = time.monotonic()
-    sig = stream_signature(args.pulse)
+    tail = PulseTail(args.pulse)
+    tail.offset = offset          # the initial read above consumed to here
     try:
         while True:
             if snaps:
@@ -237,16 +298,12 @@ def main(argv=None) -> int:
                 sys.stdout.write(f"fedtop: waiting for {args.pulse} ...\n")
             sys.stdout.flush()
             time.sleep(args.interval)
-            cur_sig = stream_signature(args.pulse)
-            if cur_sig != sig:
-                # a new run replaced the stream: restart the tail clean —
-                # keeping the old run's snapshots would mix two runs'
-                # histories (wrong first-loss, wrong round sequence), and
-                # the size-only guard in read_snapshots cannot catch a
-                # replacement that regrew past our offset within one poll
-                sig, offset = cur_sig, 0
+            fresh, reset = tail.poll()
+            if reset:
+                # a new run replaced or truncated the stream: restart the
+                # history clean — keeping the old run's snapshots would
+                # mix two runs (wrong first-loss, wrong round sequence)
                 snaps.clear()
-            fresh, offset = read_snapshots(args.pulse, offset)
             if fresh:
                 snaps.extend(fresh)
                 # bound live-mode memory on a weeks-long stream

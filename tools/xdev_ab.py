@@ -13,12 +13,20 @@ For every seed, runs the same small cross-device federation twice — serial
   materialization cannot change a record);
 - the final model leaves are bit-identical too.
 
+``--policy`` adds the fedsched sweep arm (ISSUE 13): for each seed, the
+{uniform, speed} cohort policies run over the streamed chunked round path
+(--stream_aggregate deterministic --cohort_chunk) with a STATIC count-
+prior profile snapshot — the scheduler's determinism mode — and the same
+serial-vs-pipelined bit-identity is enforced per policy. A plan that
+depended on pipeline depth, thread timing, or anything but
+(seed, round, snapshot) exits non-zero here.
+
 Exit status is non-zero if ANY cell hangs or mismatches, so this slots
 straight into CI next to tools/chaos_sweep.py.
 
 Usage: python tools/xdev_ab.py [out.json] [--seeds N] [--rounds R]
                                [--depth D] [--clients C] [--cohort K]
-                               [--timeout S]
+                               [--timeout S] [--policy]
 """
 
 from __future__ import annotations
@@ -64,6 +72,7 @@ def main(argv):
     clients = _arg(argv, "--clients", 400, int)
     cohort = _arg(argv, "--cohort", 6, int)
     timeout = _arg(argv, "--timeout", 180.0)
+    policy_sweep = "--policy" in argv
 
     import jax
     import numpy as np
@@ -80,6 +89,18 @@ def main(argv):
         {"name": "unbucketed+async",
          "kw": {"bucket_quantum_batches": 0, "async_rounds": True}},
     ]
+    if policy_sweep:
+        # the fedsched determinism arm: {uniform, speed} over the streamed
+        # chunked path, scheduled from a STATIC count-prior snapshot — the
+        # plan must be pure in (seed, round, snapshot) at any depth
+        stream_kw = {"stream_aggregate": "deterministic",
+                     "cohort_chunk": max(2, cohort // 2)}
+        grid += [
+            {"name": "policy:uniform+stream",
+             "kw": dict(stream_kw, cohort_policy="uniform"), "snap": True},
+            {"name": "policy:speed+stream",
+             "kw": dict(stream_kw, cohort_policy="speed"), "snap": True},
+        ]
 
     results, failed = [], 0
     for seed in range(seeds):
@@ -88,7 +109,7 @@ def main(argv):
             mean_records=10.0, max_records=33, multilabel=True, seed=seed)
         bundle_kw = dict(input_shape=(16,))
 
-        def run(pipeline_depth, kw):
+        def run(pipeline_depth, kw, snap=False):
             cfg = FedConfig(
                 model="lr", dataset="xdev-ab", client_num_in_total=clients,
                 client_num_per_round=cohort, comm_round=rounds, batch_size=4,
@@ -96,6 +117,11 @@ def main(argv):
                 failure_prob=0.2, host_pipeline_depth=pipeline_depth, **kw)
             api = FedAvgAPI(ds, cfg, create_model("lr", ds.class_num,
                                                   **bundle_kw))
+            if snap:
+                from fedml_tpu.data.sched import snapshot_from_counts
+
+                api.set_cohort_profiler(
+                    snapshot_from_counts(ds.train_counts))
             try:
                 losses = [float(api.run_round(r)) for r in range(rounds)]
                 leaves = [np.asarray(l) for l in jax.tree.leaves(api.variables)]
@@ -105,10 +131,12 @@ def main(argv):
 
         for cell in grid:
             rec = {"seed": seed, "config": cell["name"], "ok": False}
-            base, err = _run_with_watchdog(lambda: run(0, cell["kw"]), timeout)
+            snap = cell.get("snap", False)
+            base, err = _run_with_watchdog(
+                lambda: run(0, cell["kw"], snap), timeout)
             if err is None:
                 piped, err = _run_with_watchdog(
-                    lambda: run(depth, cell["kw"]), timeout)
+                    lambda: run(depth, cell["kw"], snap), timeout)
             if err is not None:
                 rec["error"] = err
             elif base[0] != piped[0]:
@@ -131,6 +159,7 @@ def main(argv):
     summary = {
         "seeds": seeds, "failed": failed, "depth": depth,
         "rounds": rounds, "clients": clients, "cohort": cohort,
+        "policy_sweep": policy_sweep,
         "results": results,
     }
     if out_path:
