@@ -502,15 +502,125 @@ def _bench_fedsched(tiny: bool):
     }
 
 
+def _bench_fedbuff(tiny: bool):
+    """fedbuff (ISSUE 14): sync-vs-async A/B under injected stragglers.
+
+    One small edge federation (threads, local transport), three arms on
+    the same dataset/model with the same per-message chaos delay — the
+    WAN-like iid latency whose per-round MAX gates a synchronous round:
+
+    - ``sync``: fedavg_edge rounds (strict barrier) — every round pays the
+      slowest worker's down+up latency;
+    - ``async_uniform``: fedbuff arrival mode, ``buffer_k = workers`` —
+      folds land at each worker's OWN pace, so a version emits as soon as
+      any K contributions arrive and the latency tail stops gating;
+    - ``async_speed``: + ``--cohort_policy speed`` over the count prior
+      (async dispatch composes with the fedsched CohortScheduler).
+
+    Per arm: clients/s (logical client trainings per wall second — the
+    async acceptance is async >= sync under the same injected delay) and
+    the version-lag p99 from the fold log (the staleness the decay
+    weighting absorbed instead of dropping)."""
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+    from fedml_tpu.distributed.fedbuff_edge import run_fedbuff_edge
+
+    workers = int(os.environ.get("BENCH_FEDBUFF_WORKERS", "3"))
+    cohort = workers * 2            # every fold trains exactly 2 clients
+    delay = 40.0 if tiny else float(
+        os.environ.get("BENCH_FEDBUFF_DELAY_MS", "120"))
+    versions = 3 if tiny else int(
+        os.environ.get("BENCH_FEDBUFF_VERSIONS", "8"))
+    dim = 16 if tiny else 64
+    ds = make_synthetic_classification(
+        "fedbuff-bench", (dim,), 5, cohort, records_per_client=24,
+        partition_method="hetero", partition_alpha=0.5, batch_size=8,
+        seed=0)
+
+    def cfg(**kw):
+        base = dict(
+            model="lr", dataset="fedbuff-bench", client_num_in_total=cohort,
+            client_num_per_round=cohort, comm_round=versions, batch_size=8,
+            epochs=1, lr=0.1, seed=0, frequency_of_the_test=10_000,
+            device_data="off")
+        base.update(kw)
+        return FedConfig(**base)
+
+    # absorb the jitted local-train compile OUTSIDE the timed arms (both
+    # paradigms share the jit signature, so one warm run serves all)
+    run_fedavg_edge(ds, cfg(comm_round=1), worker_num=workers)
+
+    chaos = dict(chaos_delay_ms=delay, chaos_seed=3)
+
+    def measure(label, runner, **kw):
+        t0 = time.perf_counter()
+        agg = runner(ds, cfg(**chaos, **kw), worker_num=workers)
+        dt = time.perf_counter() - t0
+        row = {"arm": label, "wall_s": round(dt, 3)}
+        if hasattr(agg, "buffer"):
+            trained = agg.uploads_folded * (cohort // workers)
+            stal = [r["staleness"] for r in agg.buffer.fold_log]
+            row.update({
+                "versions": agg.versions_emitted,
+                "folds": agg.uploads_folded,
+                "clients_per_sec": round(trained / dt, 2),
+                "version_lag_p99": (round(float(
+                    np.percentile(stal, 99)), 3) if stal else None),
+                "version_lag_mean": (round(float(np.mean(stal)), 4)
+                                     if stal else None),
+            })
+        else:
+            row.update({
+                "rounds": versions,
+                "clients_per_sec": round(versions * cohort / dt, 2),
+            })
+        return row
+
+    sync = measure("sync", run_fedavg_edge)
+    uniform = measure("async_uniform", run_fedbuff_edge,
+                      buffer_k=workers, buffer_mode="arrival")
+    from fedml_tpu.data.sched import snapshot_from_counts
+
+    counts = np.asarray([float(ds.client_slice_cached(c)[3][0])
+                         for c in range(cohort)])
+    speed = measure("async_speed",
+                    lambda d, c, worker_num: run_fedbuff_edge(
+                        d, c, worker_num=worker_num,
+                        profile_snapshot=snapshot_from_counts(counts)),
+                    buffer_k=workers, buffer_mode="arrival",
+                    cohort_policy="speed")
+    return {
+        "workers": workers,
+        "buffer_k": workers,
+        "buffer_mode": "arrival",
+        "delay_ms": delay,
+        "versions": versions,
+        "arms": [sync, uniform, speed],
+        "sync_clients_per_sec": sync["clients_per_sec"],
+        "async_clients_per_sec": uniform["clients_per_sec"],
+        "async_vs_sync": round(
+            uniform["clients_per_sec"] / sync["clients_per_sec"], 3),
+        "version_lag_p99": uniform.get("version_lag_p99"),
+    }
+
+
 def _bench_crossdevice(tiny: bool):
     """The cross-device block since ISSUE 13: headline numbers come from
     the fedsched scheduled+streamed path at million-client scale (the
     ``streamed_speed`` arm), with the r05 stackoverflow operating point
     re-measured in the same run as the same-host basis the uplift is
     judged against (the archived r05 artifact's 46.8 clients/s was a
-    different host; clients/s only compares within one run)."""
+    different host; clients/s only compares within one run). Since ISSUE
+    14 it also carries the fedbuff sync-vs-async block — LAST, because the
+    edge launchers' ``configure_from`` tears down the bench's profiler-only
+    pulse plane (pulse_path is authoritative), and every plane consumer
+    above has snapshotted by then."""
     basis = _bench_crossdevice_r05_basis(tiny)
     sched = _bench_fedsched(tiny)
+    fedbuff = None
+    if not os.environ.get("BENCH_NO_FEDBUFF"):
+        fedbuff = _bench_fedbuff(tiny)
     head = sched["arms"][-1]      # streamed_speed
     return {
         "paradigm": "cross-device scheduled streaming rounds (fedsched: "
@@ -524,6 +634,7 @@ def _bench_crossdevice(tiny: bool):
         "examples_per_sec": head["examples_per_sec"],
         "device_resident": False,
         "fedsched": sched,
+        "fedbuff": fedbuff,
         "r05_basis": basis,
         "uplift_vs_r05_basis": (
             round(head["clients_per_sec"] / basis["clients_per_sec"], 2)

@@ -1,0 +1,242 @@
+"""fedbuff: asynchronous buffered aggregation with staleness-weighted folds.
+
+Every other paradigm in the tree is round-synchronous: a round broadcasts
+one model, blocks on a barrier (or a straggler deadline that DROPS the
+slow), aggregates, repeats. FedBuff (Nguyen et al., "Federated Learning
+with Buffered Asynchronous Aggregation") removes the barrier: the server
+keeps a model **version** counter, folds every client contribution into a
+buffer the moment it is accepted, and emits a new version every ``K``
+contributions. Clients train against whatever version they last pulled;
+a contribution trained against version ``v`` folding while the server is
+at version ``V`` has **staleness** ``V - v`` and folds with the decayed
+weight
+
+    ``weight = n * (1 + staleness) ** -alpha``            (``--buffer_k``,
+                                                ``--buffer_staleness_alpha``)
+
+so stragglers CONTRIBUTE (attenuated) instead of being discarded at a
+deadline — robustness is the contract, not a feature.
+
+Contributions are **update deltas** (client model minus the version it
+trained from), not full weights: folding a half-stale full model would
+drag the server back toward the old parameters, while a stale delta is
+exactly the FedBuff update rule — and it keeps the server O(1): one
+:class:`~fedml_tpu.core.streaming.StreamAccumulator` (PR 13) holds the
+running weighted delta sum, one ``tree_add`` applies it at emission.
+With ``buffer_k == cohort`` and zero staleness an emission is
+
+    ``G + sum(n_i * (w_i - G)) / sum(n_i)  ==  sum(n_i * w_i) / sum(n_i)``
+
+— the plain FedAvg weighted mean, which is the sync-equivalence pin
+(tests/test_fedbuff.py).
+
+Fold order (``--buffer_mode``, mirroring ``--stream_aggregate``):
+
+- ``arrival``: fold the moment an upload lands — the production fast
+  path. Results depend on arrival order (which folds share a version, and
+  float summation order inside one).
+- ``deterministic``: folds advance through the canonical ``(tag, worker)``
+  frontier (:class:`DeterministicFrontier`): worker ``w``'s ``t``-th
+  contribution folds only after every ``(t', w')`` with
+  ``(t', w') < (t, w)`` that CAN still arrive has folded. Because a worker
+  only trains its ``t``-th assignment after the server answered its
+  ``(t-1)``-th fold, the frontier never deadlocks on a live worker; a
+  crash-stopped worker's slots are skipped at ejection — and since an
+  ejected worker contributes nothing past its crash point anyway, the
+  fold SEQUENCE (and therefore every version's membership, every
+  staleness value, every weight) is a pure function of
+  ``(seed, chaos_seed)``: the whole async schedule replays bit-identically
+  (the chaos crash fate counts protocol progress, comm/chaos.py). The one
+  arrival-dependent event is crash_restart RE-ADMISSION — a revived
+  worker re-enters at whatever frontier sweep its JOIN happens to land
+  in, so replay pins cover drop/dup/delay/crash-stop, and the restart
+  tests pin behavior (rejoins, correct staleness), not bits.
+
+This module is the transport-free server-side logic; the async edge
+protocol lives in distributed/fedbuff_edge.py. DESIGN.md §18 has the
+weighting math, the determinism argument, and the degradation table.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Optional
+
+import numpy as np
+
+from fedml_tpu.core.streaming import StreamAccumulator
+
+__all__ = ["DeterministicFrontier", "FedBuffBuffer", "staleness_weight"]
+
+Pytree = Any
+
+
+def staleness_weight(n: float, staleness: int, alpha: float) -> float:
+    """The FedBuff fold weight: sample count decayed polynomially in the
+    version lag — ``n * (1 + staleness)^-alpha``. ``alpha == 0`` disables
+    the decay (pure sample weighting); staleness 0 is always undecayed."""
+    s = max(int(staleness), 0)
+    return float(n) * float(1 + s) ** -float(alpha)
+
+
+class FedBuffBuffer:
+    """Versioned staleness-weighted delta buffer (module docstring).
+
+    Thread-safe (the edge server's handler thread and the reliable layer's
+    control injections serialize upstream, but the probe/keepalive timers
+    do not). The accumulator always folds in the order :meth:`fold` is
+    called — the CALLER owns the order contract: the deterministic
+    frontier feeds canonical order, the arrival path feeds arrival order.
+    """
+
+    def __init__(self, k: int, alpha: float = 0.5, fold_log_cap: int = 4096):
+        if k < 1:
+            raise ValueError(f"buffer_k must be >= 1, got {k}")
+        self.k = int(k)
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._acc = StreamAccumulator("arrival")
+        #: the server's model version: bumped at every emission
+        self.version = 0
+        #: folds since the last emission (resets at emission)
+        self.pending = 0
+        #: lifetime fold count — the exact-once accounting surface
+        self.folds = 0
+        self.zero_weight_folds = 0
+        self.versions_emitted = 0
+        #: bounded per-fold record trail for tests/diagnostics:
+        #: (version-at-fold, staleness, weight, n)
+        self.fold_log: deque = deque(maxlen=int(fold_log_cap))
+        #: staleness values folded into the CURRENT pending version
+        self._pending_staleness: list[int] = []
+
+    def fold(self, delta: Pytree, n: float, trained_version: int) -> dict:
+        """Fold one contribution's update delta; returns the fold record
+        (``staleness``, ``weight``). Staleness is measured against the
+        CURRENT version at fold time — in deterministic mode that makes it
+        a pure function of the canonical fold sequence."""
+        with self._lock:
+            staleness = max(self.version - int(trained_version), 0)
+            weight = staleness_weight(n, staleness, self.alpha)
+            self._acc.add(self.folds, delta, weight)
+            self.folds += 1
+            self.pending += 1
+            if weight <= 0.0:
+                self.zero_weight_folds += 1
+            self._pending_staleness.append(staleness)
+            rec = {"version": self.version, "staleness": staleness,
+                   "weight": weight, "n": float(n)}
+            self.fold_log.append(rec)
+            return rec
+
+    @property
+    def ready(self) -> bool:
+        return self.pending >= self.k
+
+    def emit(self, params: Pytree) -> tuple[Pytree, dict]:
+        """Close the pending buffer into a new model version:
+        ``params + weighted_mean(deltas)`` (an all-zero-weight buffer is
+        the elastic no-op — params unchanged, version still bumps so lag
+        accounting stays monotone). Returns ``(new_params, emission
+        record)``."""
+        from fedml_tpu.core.pytree import tree_add
+
+        with self._lock:
+            mean_delta = self._acc.finalize(params)
+            stal = self._pending_staleness
+            rec = {
+                "version": self.version + 1,
+                "folds": self.pending,
+                "staleness_max": max(stal, default=0),
+                "staleness_mean": (round(float(np.mean(stal)), 4)
+                                   if stal else 0.0),
+            }
+            self._acc = StreamAccumulator("arrival")
+            self.pending = 0
+            self._pending_staleness = []
+            self.version += 1
+            self.versions_emitted += 1
+        if mean_delta is not None:
+            params = tree_add(params, mean_delta)
+        return params, rec
+
+    @property
+    def nbytes(self) -> int:
+        """Measured buffer footprint: ONE model-shaped running sum,
+        independent of K and of how many contributions folded."""
+        return self._acc.nbytes
+
+
+class DeterministicFrontier:
+    """Canonical ``(tag, worker)`` fold-order frontier for deterministic
+    mode.
+
+    Each admitted worker has a next expected train tag; the frontier's
+    head is the minimum ``(tag, worker)`` over admitted workers. Offered
+    contributions are held until they reach the head; :meth:`drain` yields
+    them in canonical order. Ejecting a worker removes its slots — the
+    relative order of everyone else's folds is unchanged, which is why a
+    late ejection (the gave-up detection latency is wall-clock) cannot
+    change the fold sequence: the ejected worker's missing slots were
+    never going to arrive. NOT thread-safe; the owning server serializes
+    access on its receive loop.
+    """
+
+    def __init__(self, workers):
+        #: worker -> next expected tag (admitted workers only)
+        self._next: dict[int, int] = {int(w): 0 for w in workers}
+        self._held: dict[tuple[int, int], Any] = {}
+        self.peak_held = 0
+
+    @property
+    def admitted(self) -> set:
+        return set(self._next)
+
+    def head(self) -> Optional[tuple[int, int]]:
+        """The canonical slot the frontier is waiting on, or None when no
+        worker is admitted."""
+        if not self._next:
+            return None
+        return min((t, w) for w, t in self._next.items())
+
+    def offer(self, worker: int, tag: int, item) -> bool:
+        """Hold one contribution at its canonical slot. Returns False (a
+        duplicate / already-folded slot / unadmitted worker) when the
+        contribution must not fold."""
+        w, t = int(worker), int(tag)
+        nxt = self._next.get(w)
+        if nxt is None or t < nxt or (t, w) in self._held:
+            return False
+        self._held[(t, w)] = item
+        self.peak_held = max(self.peak_held, len(self._held))
+        return True
+
+    def drain(self):
+        """Yield held contributions in canonical order while the head slot
+        is available."""
+        while True:
+            head = self.head()
+            if head is None or head not in self._held:
+                return
+            item = self._held.pop(head)
+            t, w = head
+            self._next[w] = t + 1
+            yield w, t, item
+
+    def eject(self, worker: int) -> None:
+        """Remove a (dead) worker: its future slots stop gating the
+        frontier; anything it had held is discarded."""
+        w = int(worker)
+        self._next.pop(w, None)
+        for slot in [s for s in self._held if s[1] == w]:
+            self._held.pop(slot)
+
+    def admit(self, worker: int, from_tag: int) -> None:
+        """(Re-)admit a worker starting at ``from_tag`` — the rejoin path.
+        In deterministic mode the re-admission sweep is the one
+        arrival-dependent event (class docstring)."""
+        self._next[int(worker)] = int(from_tag)
+
+    def next_tag(self, worker: int) -> Optional[int]:
+        return self._next.get(int(worker))

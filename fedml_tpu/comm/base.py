@@ -66,3 +66,17 @@ class BaseCommunicationManager(abc.ABC):
     def _notify(self, msg: Message) -> None:
         for obs in list(self._observers):
             obs.receive_message(msg.get_type(), msg)
+
+
+def find_layer(comm, cls):
+    """Walk a wire middleware stack (the ``.inner`` chain: reliable over
+    chaos over a bare transport) down to the first layer of ``cls`` —
+    None when that middleware isn't stacked. The one walk protocol code
+    uses to reach a specific layer's hooks (fedbuff's gave-up ejection
+    oracle, the chaos ``on_restart`` re-announce)."""
+    node = comm
+    while node is not None:
+        if isinstance(node, cls):
+            return node
+        node = getattr(node, "inner", None)
+    return None

@@ -4,13 +4,26 @@
 WAN on the SEND side: it drops, duplicates, delays, and reorders messages,
 and can crash-stop its rank after a configured number of sends (the
 killed-process failure model the straggler-deadline machinery exists for).
+With ``restart_after_s`` the crash becomes a ``crash_restart`` fate: the
+rank goes silent in BOTH directions (outbound swallowed, inbound dropped,
+receive loop kept alive) and revives after the configured delay — the
+recovery path (rejoin, catch-up, staleness accounting), not just death.
+An ``on_restart`` hook lets the protocol layer re-announce itself (the
+fedbuff client sends JOIN from it).
 
 Every fault decision is drawn from ``np.random.default_rng`` seeded by
 (chaos_seed, message identity, delivery attempt) — NOT from a shared
 stream — so the fate of each transmission is a pure function of the seed
 and the message, independent of thread interleaving: the retransmit thread
 racing the protocol thread cannot change which copies the wire eats. A
-sweep over seeds (tools/chaos_sweep.py) is therefore reproducible.
+sweep over seeds (tools/chaos_sweep.py) is therefore reproducible. The
+crash trigger counts LOGICAL protocol messages (first attempts of non-ack
+messages), not raw wire sends: retransmit storms and ack traffic are
+timing-dependent, so a raw-send trigger would move the crash point between
+replays — counting protocol progress keeps the set of messages a crashed
+rank managed to originate a pure function of (seed, chaos_seed), which is
+what makes fedbuff's deterministic mode bit-identical replayable under
+crash chaos (tests/test_fedbuff.py).
 
 Chaos sits UNDER the reliable layer (comm/reliable.py): acks ride the same
 lossy wire, so a dropped ack exercises retransmit + dedup end to end.
@@ -58,6 +71,7 @@ class ChaosCommManager(BaseCommunicationManager, Observer):
         seed: int = 0,
         rank: int = 0,
         crash_after_sends: Optional[int] = None,
+        restart_after_s: Optional[float] = None,
     ):
         super().__init__(codec=inner.codec)
         self.inner = inner
@@ -68,53 +82,79 @@ class ChaosCommManager(BaseCommunicationManager, Observer):
         self.seed = int(seed)
         self.rank = int(rank)
         self.crash_after_sends = crash_after_sends
-        self._sends = 0
+        self.restart_after_s = (None if restart_after_s is None
+                                else float(restart_after_s))
+        #: protocol layers hook this to re-announce after a crash_restart
+        #: revival (e.g. the fedbuff client's JOIN); called off-thread
+        self.on_restart = None
+        self._sends = 0                # LOGICAL protocol messages originated
         self._occurrence: dict = {}    # fate key -> times seen (attempt idx)
         self._held = None              # reorder buffer: (msg, delay_s)
         self._crashed = False
+        self._crash_fired = False      # the crash fate is single-shot
         self._lock = threading.Lock()
         # registry-backed counter view (fedml_tpu/obs) — same keys/access
         from fedml_tpu.obs import default_registry
 
         self.stats = default_registry().group("chaos", rank=self.rank, keys=(
             "sent", "dropped", "duplicated", "delayed",
-            "reordered", "crashed_dropped", "crash_stops",
+            "reordered", "crashed_dropped", "crash_stops", "crash_restarts",
         ))
         inner.add_observer(self)
 
     # -- deterministic fate ------------------------------------------------
-    def _fate_rng(self, msg: Message) -> np.random.Generator:
-        """Per-(message, attempt) generator: the fate of attempt N of a given
-        logical message is fixed by the seed alone — thread timing between
-        the protocol and retransmit threads cannot reshuffle the draws."""
+    @staticmethod
+    def _fate_ident(msg: Message) -> tuple:
+        """Logical identity of a transmission: retransmits of one stamped
+        message share the ident and are told apart by the attempt index."""
         if msg.get_type() == MSG_TYPE_WIRE_ACK:
             from fedml_tpu.comm.reliable import KEY_ACK_SEQ
 
-            ident = ("ack", msg.get_sender_id(), msg.get_receiver_id(),
-                     msg.get(KEY_ACK_SEQ))
-        else:
-            seq = msg.get(MSG_ARG_KEY_WIRE_SEQ)
-            ident = ("msg", msg.get_sender_id(), msg.get_receiver_id(),
-                     seq if seq is not None else str(msg.get_type()))
-        with self._lock:
-            attempt = self._occurrence.get(ident, 0)
-            self._occurrence[ident] = attempt + 1
-        digest = hashlib.blake2s(repr(ident).encode(), digest_size=8).digest()
-        return np.random.default_rng(
-            [self.seed, int.from_bytes(digest, "big"), attempt])
+            return ("ack", msg.get_sender_id(), msg.get_receiver_id(),
+                    msg.get(KEY_ACK_SEQ))
+        seq = msg.get(MSG_ARG_KEY_WIRE_SEQ)
+        return ("msg", msg.get_sender_id(), msg.get_receiver_id(),
+                seq if seq is not None else str(msg.get_type()))
 
     # -- send path ---------------------------------------------------------
     def send_message(self, msg: Message) -> None:
+        ident = self._fate_ident(msg)
         with self._lock:
             if self._crashed:
                 self.stats["crashed_dropped"] += 1
                 return
-            self._sends += 1
+            attempt = self._occurrence.get(ident, 0)
+            self._occurrence[ident] = attempt + 1
+            # crash trigger counts LOGICAL protocol messages (first attempt,
+            # non-ack): retransmit/ack volume is thread-timing dependent, so
+            # counting raw sends would move the crash point between replays
+            if ident[0] != "ack" and (attempt == 0
+                                      or msg.get(MSG_ARG_KEY_WIRE_SEQ) is None):
+                self._sends += 1
             crash_now = (self.crash_after_sends is not None
+                         and not self._crash_fired
                          and self._sends >= self.crash_after_sends)
-        # always burn all four draws so each decision is independent of the
-        # others' rates — changing one rate never re-deals the rest
-        r_drop, r_dup, r_reorder, u_delay = self._fate_rng(msg).random(4)
+            if crash_now:
+                # mark the crash INSIDE the lock, the instant it is
+                # decided: a concurrent retransmit entering send_message
+                # in the window between deciding and executing the crash
+                # would otherwise dispatch in one interleaving and be
+                # swallowed in another — the delivered set must be pure
+                # in (seed, protocol progress). The threshold send itself
+                # (this call) still goes out, then the rank goes dark.
+                self._crashed = True
+                self._crash_fired = True
+                self._held = None
+                self.stats["crash_stops"] += 1
+        # per-(message, attempt) generator: the fate of attempt N of a given
+        # logical message is fixed by the seed alone — thread timing between
+        # the protocol and retransmit threads cannot reshuffle the draws.
+        # Always burn all four draws so each decision is independent of the
+        # others' rates — changing one rate never re-deals the rest.
+        digest = hashlib.blake2s(repr(ident).encode(), digest_size=8).digest()
+        rng = np.random.default_rng(
+            [self.seed, int.from_bytes(digest, "big"), attempt])
+        r_drop, r_dup, r_reorder, u_delay = rng.random(4)
         try:
             if r_drop < self.drop:
                 with self._lock:   # counters race: concurrent retransmit sends
@@ -174,18 +214,43 @@ class ChaosCommManager(BaseCommunicationManager, Observer):
         t.start()
 
     def _crash(self) -> None:
-        """Crash-stop this rank: go silent in both directions and exit the
-        receive loop — the in-process equivalent of kill -9, the failure the
-        straggler deadline + JOIN/rejoin machinery handles."""
+        """Finish the crash-stop marked in ``send_message`` (the mark —
+        ``_crashed``/counters — happens under the lock at the instant the
+        fate is decided; this out-of-lock half runs after the threshold
+        send completes): the in-process equivalent of kill -9, the
+        failure the straggler deadline + JOIN/rejoin machinery handles.
+        Permanent crash exits the receive loop; a crash_restart fate
+        (``restart_after_s``) keeps the loop alive (inbound is swallowed
+        while down) and arms the revival timer instead."""
+        restart = self.restart_after_s
+        LOG.warning("chaos: rank %d crash-stopped after %d protocol sends%s",
+                    self.rank, self._sends,
+                    "" if restart is None else f" (restart in {restart:g}s)")
+        if restart is None:
+            self.inner.stop_receive_message()
+            return
+        t = threading.Timer(restart, self._restart)
+        t.daemon = True
+        t.start()
+
+    def _restart(self) -> None:
+        """crash_restart revival: traffic flows again in both directions.
+        Everything the wire carried during the outage is gone (peers'
+        reliable-layer retransmits recover what their retry budgets still
+        cover); ``on_restart`` lets the protocol re-announce itself."""
         with self._lock:
-            if self._crashed:
+            if not self._crashed:
                 return
-            self._crashed = True
-            self._held = None
-            self.stats["crash_stops"] += 1
-        LOG.warning("chaos: rank %d crash-stopped after %d sends",
-                    self.rank, self._sends)
-        self.inner.stop_receive_message()
+            self._crashed = False
+            self.stats["crash_restarts"] += 1
+            cb = self.on_restart
+        LOG.warning("chaos: rank %d revived (crash_restart)", self.rank)
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                LOG.exception("chaos: rank %d on_restart hook failed",
+                              self.rank)
 
     # -- receive path ------------------------------------------------------
     def receive_message(self, msg_type, msg: Message) -> None:
@@ -214,3 +279,11 @@ class ChaosCommManager(BaseCommunicationManager, Observer):
 
     def supports_local_injection(self) -> bool:
         return self.inner.supports_local_injection()
+
+
+def find_chaos(comm) -> Optional[ChaosCommManager]:
+    """``comm.base.find_layer`` for the chaos wrapper — protocol layers
+    use it to hook ``on_restart``."""
+    from fedml_tpu.comm.base import find_layer
+
+    return find_layer(comm, ChaosCommManager)

@@ -114,6 +114,11 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
             "gave_up", "acked", "acks_sent",
             "delivered", "dup_dropped",
         ))
+        #: optional ``(receiver_rank, msg) -> None`` hook invoked (off the
+        #: registry lock) when a message to that peer exhausts its retries —
+        #: the death oracle async protocols eject crash-stopped clients by
+        #: (fedbuff: the hook injects a local PEER_GAVE_UP control event)
+        self.on_gave_up = None
         inner.add_observer(self)
         self._retx = threading.Thread(
             target=self._retransmit_loop, daemon=True,
@@ -166,6 +171,7 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
     def _retransmit_loop(self) -> None:
         while True:
             due = []
+            gave_up = []
             with self._cv:
                 if self._closed:
                     return
@@ -182,6 +188,7 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                     if p.attempts > self.retry_max:
                         self._outstanding.pop(mid)
                         self.stats["gave_up"] += 1
+                        gave_up.append(p)
                         self._cv.notify_all()
                         LOG.warning(
                             "rank %d: message %r to %d unacked after %d "
@@ -191,9 +198,17 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                     p.next_due = now + self._backoff(p.attempts)
                     p.in_flight = True
                     due.append(p)
-                if not due:
+                if not due and not gave_up:
                     self._cv.wait(timeout=wait)
                     continue
+            for p in gave_up:
+                cb = self.on_gave_up
+                if cb is not None:
+                    try:
+                        cb(p.receiver, p.msg)
+                    except Exception:
+                        LOG.exception("rank %d: on_gave_up hook failed",
+                                      self.rank)
             # one thread per due message: a blocking transport (gRPC
             # wait_for_ready on a dead peer) must not starve retransmits to
             # LIVE peers — that starvation is exactly how a lost FINISH to
@@ -317,6 +332,23 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
         return self.inner.supports_local_injection()
 
 
+def retry_schedule(config) -> tuple[float, float, int]:
+    """(base_s, cap_s, retry_max) from the config knobs. The cap scales
+    with the base (20x — the default pair 0.05/1.0 preserved), so one base
+    knob retunes the whole schedule."""
+    base = float(getattr(config, "wire_retry_base_s", 0.05) or 0.05)
+    return base, 20.0 * base, int(getattr(config, "wire_retry_max", 10) or 10)
+
+
+def retry_budget_s(config) -> float:
+    """Total worst-case backoff before a message gives up under ``config``'s
+    retry schedule — the wire's detection latency for a dead peer. Probe
+    and keepalive cadences derive from it so a liveness check never
+    re-sends while the original could still legitimately deliver."""
+    base, cap, retry_max = retry_schedule(config)
+    return float(sum(min(base * (2 ** i), cap) for i in range(retry_max + 1)))
+
+
 def build_wire_stack(comm: BaseCommunicationManager, config,
                      rank: int) -> BaseCommunicationManager:
     """Wrap a bare transport per config: chaos injection innermost (it IS
@@ -336,9 +368,17 @@ def build_wire_stack(comm: BaseCommunicationManager, config,
             seed=getattr(config, "chaos_seed", 0),
             rank=rank,
             crash_after_sends=crash_after,
+            restart_after_s=(getattr(config, "chaos_crash_restart_s", None)
+                             if crash_after is not None else None),
         )
     if getattr(config, "wire_reliable", False):
-        comm = ReliableCommManager(comm, rank=rank)
+        base, cap, retry_max = retry_schedule(config)
+        comm = ReliableCommManager(
+            comm, rank=rank, retry_base_s=base, retry_cap_s=cap,
+            retry_max=retry_max,
+            # the drain exists to host retry exhaustion: scale it with the
+            # schedule instead of racing a fixed 8 s against a retuned one
+            drain_timeout_s=retry_budget_s(config) + 0.5)
     return comm
 
 

@@ -104,6 +104,16 @@ class FedConfig:
     # (tests/test_chaos.py), so the only cost of enabling it is the ack
     # traffic. Required whenever chaos drop/dup/reorder rates are set.
     wire_reliable: bool = False
+    # Reliable-layer retry schedule: exponential backoff from
+    # wire_retry_base_s (cap at 20x the base) for up to wire_retry_max
+    # retransmits before a message is abandoned (gave_up — the dead-peer
+    # oracle fedbuff ejects by). The defaults reproduce the layer's
+    # historical schedule (~6.6 s to exhaustion); a LAN/CI federation can
+    # shrink detection latency by an order of magnitude, a lossy WAN can
+    # deepen the budget. The teardown drain window derives from the
+    # schedule automatically.
+    wire_retry_base_s: float = 0.05
+    wire_retry_max: int = 10
     # Chaos injection (comm/chaos.py): seeded, deterministic wire faults for
     # robustness testing. Rates are per-transmission probabilities; delay is
     # the max per-message latency in ms (uniform draw). chaos_crash_rank /
@@ -116,6 +126,11 @@ class FedConfig:
     chaos_reorder: float = 0.0
     chaos_crash_rank: Optional[int] = None
     chaos_crash_after: Optional[int] = None
+    # crash_restart fate: the crash-stopped rank REVIVES after this many
+    # seconds of total silence (both directions) and its protocol layer
+    # re-announces itself (JOIN) — the recovery path, not just death.
+    # None (default) keeps crash-stop permanent.
+    chaos_crash_restart_s: Optional[float] = None
     frequency_of_the_test: int = 5
     is_mobile: int = 0
     seed: int = 0
@@ -223,6 +238,24 @@ class FedConfig:
     # profiler-snapshot-at-schedule-time); with no profiler (pulse plane
     # off) they schedule uniform cold-starts and warn once.
     cohort_policy: str = "uniform"
+    # fedbuff: asynchronous buffered aggregation (algorithms/fedbuff.py +
+    # distributed/fedbuff_edge.py). The server folds each client upload
+    # (an update delta against the model version the client trained from)
+    # into a StreamAccumulator with a staleness-decayed weight
+    # ``n * (1 + staleness)^-buffer_staleness_alpha`` where staleness =
+    # server_version - trained_version, and emits a new model version every
+    # ``buffer_k`` contributions — no round barrier, no straggler deadline:
+    # slow clients contribute with decayed weight instead of being dropped.
+    buffer_k: int = 4
+    buffer_staleness_alpha: float = 0.5
+    # Fold-order contract (mirrors --stream_aggregate): "arrival" folds
+    # each upload the moment it lands (the production fast path — results
+    # depend on arrival order through float summation + version grouping);
+    # "deterministic" folds in the canonical (train-tag, worker) frontier
+    # order, making the WHOLE async schedule a pure function of
+    # (seed, chaos_seed) — bit-identical replayable under chaos
+    # (tests/test_fedbuff.py pins it on local + grpc).
+    buffer_mode: str = "arrival"
     # Streaming server-side aggregation (core/streaming.py + the chunked
     # host round path): fold each client contribution into a running
     # weighted accumulator instead of buffering the whole cohort — O(1)
@@ -310,6 +343,14 @@ class FedConfig:
     health_stall_sec: Optional[float] = None  # round wall > this -> stall
     health_stale_spike: int = 8           # stale_uploads delta/round -> warn
     health_skew: float = 4.0              # p95/p50 EMA train-ms -> warn
+    # fedbuff version-lag rule: warn when THIS round's staleness-sketch
+    # delta p99 (rounds/versions behind per contribution) reaches this
+    # many versions; escalates to critical when the p99 grows strictly
+    # monotonically for VERSION_LAG_MONOTONIC_N consecutive snapshots —
+    # the buffered-async divergence signature (clients falling ever
+    # further behind the emitted version). 0 = rule off (sync runs keep
+    # their stale_spike rule; async launchers arm this one).
+    health_version_lag: float = 0.0
     # escalate-to-raise: any critical health event raises
     # FederationHealthError AFTER its pulse snapshot is written
     health_escalate: bool = False
@@ -368,6 +409,24 @@ class FedConfig:
             raise ValueError(
                 f"stream_aggregate must be off|deterministic|arrival, got "
                 f"{self.stream_aggregate!r}")
+        if self.wire_retry_base_s <= 0:
+            raise ValueError(
+                f"wire_retry_base_s must be > 0, got {self.wire_retry_base_s}")
+        if self.wire_retry_max < 1:
+            raise ValueError(
+                f"wire_retry_max must be >= 1, got {self.wire_retry_max}")
+        if self.buffer_k < 1:
+            raise ValueError(
+                f"buffer_k must be >= 1, got {self.buffer_k}: a version "
+                "emits every buffer_k folded contributions")
+        if self.buffer_staleness_alpha < 0.0:
+            raise ValueError(
+                f"buffer_staleness_alpha must be >= 0, got "
+                f"{self.buffer_staleness_alpha} (0 = no staleness decay)")
+        if self.buffer_mode not in ("deterministic", "arrival"):
+            raise ValueError(
+                f"buffer_mode must be deterministic|arrival, got "
+                f"{self.buffer_mode!r}")
         if self.cohort_chunk < 0:
             raise ValueError(
                 f"cohort_chunk must be >= 0, got {self.cohort_chunk}")
@@ -453,6 +512,20 @@ class FedConfig:
             raise ValueError(
                 "chaos_crash_rank and chaos_crash_after must be set together"
             )
+        if self.chaos_crash_restart_s is not None:
+            if self.chaos_crash_rank is None:
+                raise ValueError(
+                    "chaos_crash_restart_s needs chaos_crash_rank/"
+                    "chaos_crash_after: a restart delay without a crash "
+                    "fate has nothing to revive")
+            if self.chaos_crash_restart_s <= 0:
+                raise ValueError(
+                    f"chaos_crash_restart_s must be > 0, got "
+                    f"{self.chaos_crash_restart_s}")
+        if self.health_version_lag < 0:
+            raise ValueError(
+                f"health_version_lag must be >= 0, got "
+                f"{self.health_version_lag}")
         from fedml_tpu.core.compression import parse_codec
 
         parse_codec(self.wire_codec)   # raises on an unknown codec spec
@@ -580,6 +653,18 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                         "updates into a running weighted accumulator (O(1) "
                         "memory in cohort size) in fixed plan order "
                         "(deterministic) or strictly on arrival")
+    p.add_argument("--buffer_k", type=int, default=defaults.buffer_k,
+                   help="fedbuff: emit a model version every K folded "
+                        "contributions (async buffered aggregation)")
+    p.add_argument("--buffer_staleness_alpha", type=float,
+                   default=defaults.buffer_staleness_alpha,
+                   help="fedbuff staleness decay: fold weight = "
+                        "n * (1 + staleness)^-alpha (0 = no decay)")
+    p.add_argument("--buffer_mode", type=str, default=defaults.buffer_mode,
+                   choices=("deterministic", "arrival"),
+                   help="fedbuff fold order: canonical (tag, worker) "
+                        "frontier — bit-identical replayable from (seed, "
+                        "chaos_seed) — or strictly on arrival (fast path)")
     p.add_argument("--cohort_chunk", type=int, default=defaults.cohort_chunk,
                    help="stream the host round in sub-cohorts of this many "
                         "clients through the accumulator (0 = whole cohort; "
@@ -595,6 +680,13 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--wire_reliable", type=lambda s: bool(int(s)),
                    default=defaults.wire_reliable,
                    help="ACK/retransmit + dedup wire layer (0|1)")
+    p.add_argument("--wire_retry_base_s", type=float,
+                   default=defaults.wire_retry_base_s,
+                   help="reliable-layer backoff base (cap = 20x base)")
+    p.add_argument("--wire_retry_max", type=int,
+                   default=defaults.wire_retry_max,
+                   help="retransmits before a message gives up (the "
+                        "dead-peer detection budget)")
     p.add_argument("--chaos_seed", type=int, default=defaults.chaos_seed)
     p.add_argument("--chaos_drop", type=float, default=defaults.chaos_drop,
                    help="P(drop) per transmission (needs --wire_reliable 1)")
@@ -609,6 +701,9 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--chaos_crash_rank", type=int, default=None,
                    help="crash-stop this rank after --chaos_crash_after sends")
     p.add_argument("--chaos_crash_after", type=int, default=None)
+    p.add_argument("--chaos_crash_restart_s", type=float, default=None,
+                   help="crash_restart fate: revive the crash-stopped rank "
+                        "after this many seconds (None = crash is final)")
     p.add_argument("--trace_dir", type=str, default=None,
                    help="write per-rank span traces (fedml_tpu/obs) here; "
                         "analyze with tools/trace_report.py")
@@ -646,6 +741,11 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--health_skew", type=float, default=defaults.health_skew,
                    help="watchdog: p95/p50 EMA train-ms ratio flagged as "
                         "straggler skew (0 = rule off)")
+    p.add_argument("--health_version_lag", type=float,
+                   default=defaults.health_version_lag,
+                   help="watchdog: per-round staleness-sketch delta p99 "
+                        "(versions behind) that warns; monotonic growth "
+                        "escalates to critical (0 = rule off)")
     p.add_argument("--health_escalate", type=lambda s: bool(int(s)),
                    default=defaults.health_escalate,
                    help="raise FederationHealthError on critical health "

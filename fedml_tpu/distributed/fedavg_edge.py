@@ -246,9 +246,15 @@ class FedAvgEdgeServerManager(ServerManager):
         self._alive = {w: True for w in range(size - 1)}
         # uploads dropped as stale (wrong round tag / pre-re-deal gen): a
         # RETRANSMITTED upload landing after its round was deadline-closed
-        # counts here, never in the aggregate — surfaced with the wire
-        # counters so a lossy run is diagnosable
-        self.stale_uploads = 0
+        # counts here, never in the aggregate. A registry WIRE-lane counter
+        # (not a plain attribute): pulse snapshots, the watchdog's
+        # stale_spike delta rule and trace_report's registry section all
+        # see it LIVE alongside the reliable layer's counters, instead of
+        # a hand-stamped value at teardown.
+        from fedml_tpu.obs import default_registry
+
+        self._wire_lane = default_registry().group(
+            "wire", rank=0, keys=("stale_uploads",))
         self._lost_clients: list[int] = []
         self._assignment_map: dict[int, list[int]] = {}
         self._expected: set[int] = set(range(size - 1))
@@ -285,6 +291,12 @@ class FedAvgEdgeServerManager(ServerManager):
         self._round_t0 = time.perf_counter()
 
     _MAX_EMPTY_DEADLINES = MAX_EMPTY_DEADLINES
+
+    @property
+    def stale_uploads(self) -> int:
+        """The registry wire-lane counter (kept as an attribute-shaped read
+        for the existing callers/tests)."""
+        return self._wire_lane["stale_uploads"]
 
     def run(self):
         self.register_message_receive_handlers()
@@ -539,13 +551,13 @@ class FedAvgEdgeServerManager(ServerManager):
                 # late (possibly retransmitted) upload of a round that was
                 # already deadline-closed: stale, never double-aggregated.
                 # Its rounds-behind lag feeds the staleness sketch lane —
-                # the tail FedBuff's version-lag weighting will read.
-                self.stale_uploads += 1
+                # the same lane fedbuff's version lag writes.
+                self._wire_lane["stale_uploads"] += 1
                 self._observe_stale(self.round_idx - int(tag))
                 return
             gen = msg.get(MSG_ARG_KEY_GEN)
             if gen is not None and int(gen) != self._bcast_gen:
-                self.stale_uploads += 1
+                self._wire_lane["stale_uploads"] += 1
                 # pre-re-deal upload of the CURRENT round: 0 rounds behind
                 self._observe_stale(0)
                 return
@@ -628,13 +640,14 @@ class FedAvgEdgeServerManager(ServerManager):
             # watchdog's spike rules see them. May raise (escalate mode) —
             # AFTER the snapshot is written, and the round is already
             # aggregated, so the stream records the dying state.
+            # stale_uploads is NOT in extra: it rides the registry wire
+            # lane live (the watchdog's stale_spike delta reads it there)
             pulse.on_round(
                 self.round_idx, source="edge_server",
                 loss=(float(metrics["loss"]) if metrics
                       and metrics.get("loss") is not None else None),
                 round_ms=(time.perf_counter() - self._round_t0) * 1e3,
-                extra={"stale_uploads": self.stale_uploads,
-                       "uploads": uploads,
+                extra={"uploads": uploads,
                        "workers_alive": sum(
                            1 for a in self._alive.values() if a)})
         self.round_idx += 1
@@ -934,7 +947,12 @@ def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = Tru
 
     aggregator.wire_stats = merge_wire_stats(
         [m.com_manager for m in managers])
-    aggregator.wire_stats["wire/stale_uploads"] = managers[0].stale_uploads
+    # the server's own wire-lane counters (stale_uploads) live in the
+    # registry — pulse/watchdog/trace_report read them live; this only
+    # folds the same group into the end-of-run summary view
+    for k, v in managers[0]._wire_lane.items():
+        key = f"wire/{k}"
+        aggregator.wire_stats[key] = aggregator.wire_stats.get(key, 0) + v
     anomalies = ("wire/retransmits", "wire/retransmit_errors", "wire/gave_up",
                  "wire/dup_dropped", "wire/stale_uploads")
     if any(aggregator.wire_stats.get(k, 0) for k in anomalies) or any(
@@ -1003,5 +1021,9 @@ def run_fedavg_edge_rank(dataset, config):
     if config.rank != 0:
         return None
     manager.aggregator.wire_stats = stats
-    manager.aggregator.wire_stats["wire/stale_uploads"] = manager.stale_uploads
+    # registry wire-lane counters (stale_uploads): live during the run,
+    # folded into the summary view here
+    for k, v in manager._wire_lane.items():
+        key = f"wire/{k}"
+        stats[key] = stats.get(key, 0) + v
     return manager.aggregator

@@ -1,0 +1,757 @@
+"""Message-driven FedBuff: asynchronous buffered aggregation at the edge.
+
+The synchronous edge protocol (distributed/fedavg_edge.py) broadcasts one
+model per round and blocks on a barrier or a straggler deadline; a slow or
+flaky client either gates the round or gets DROPPED at the deadline. This
+module is the paradigm the sync stack can't reach (ROADMAP "asynchronous
+buffered aggregation"): there are no rounds on the wire at all —
+
+- the server answers every accepted upload IMMEDIATELY (arrival mode) with
+  the current model version and the worker's next assignment, so a fast
+  worker loops at its own pace while a slow one simply contributes later
+  with a staleness-decayed weight (algorithms/fedbuff.py);
+- a model version is emitted every ``--buffer_k`` folded contributions;
+  per-version evaluation, pulse snapshots (version-lag in the ``staleness``
+  sketch lane + ``server_version`` on the wire lane) and the health
+  watchdog's ``version_lag`` rule hang off the emission boundary;
+- crash-stopped workers are ejected by the reliable layer's gave-up path
+  (``on_gave_up`` → a local PEER_GAVE_UP control event on the server's own
+  receive loop), never by discarding their contributions; a revived worker
+  (chaos ``crash_restart`` or a real process restart) re-enters via JOIN —
+  or via its own retransmitted upload — and contributes with the staleness
+  its lag earned;
+- ``--buffer_mode deterministic`` folds through the canonical
+  ``(train-tag, worker)`` frontier instead: replies are held until the
+  frontier stalls, so the entire async schedule — fold order, version
+  membership, staleness values, weights — is a pure function of
+  ``(seed, chaos_seed)`` and replays bit-identically under drop/dup/delay/
+  crash chaos (tests/test_fedbuff.py pins local + grpc). With
+  ``buffer_k == worker count`` this degenerates to exactly synchronous
+  FedAvg (the sync-equivalence pin). A stalled frontier re-sends the
+  blocking worker's assignment on a probe timer, so a crash that left no
+  unacked traffic still reaches the gave-up oracle (and a live worker
+  starved by an abandoned message is un-wedged) — version emission never
+  stalls on a corpse.
+
+Assignments compose with the fedsched :class:`CohortScheduler`: the sweep
+tag is the scheduler's round index, so ``--cohort_policy speed|fair``
+shapes async cohorts exactly as it shapes sync ones (uniform stays
+bit-identical to ``sample_clients`` by construction — the sync-equivalence
+pin depends on it). Worker ``w`` takes the tag-``t`` cohort's slice
+``cohort[w::workers]`` — a pure function of ``(seed, tag, w)``, never of
+the alive set, so an ejection cannot reshuffle anyone else's data.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from fedml_tpu.comm import ClientManager, Message, ServerManager
+from fedml_tpu.comm.local import run_ranks
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_CLIENT_INDEX,
+    MSG_ARG_KEY_MODEL_PARAMS,
+    MSG_ARG_KEY_NUM_SAMPLES,
+)
+from fedml_tpu.algorithms.fedbuff import DeterministicFrontier, FedBuffBuffer
+from fedml_tpu.core.tasks import get_task
+from fedml_tpu.distributed.fedavg_edge import (
+    MSG_ARG_KEY_MODEL_DELTA,
+    FedAVGTrainer,
+    _edge_args,
+)
+from fedml_tpu.models import create_model
+from fedml_tpu.parallel.local import finalize_metrics, make_eval_fn
+
+LOG = logging.getLogger(__name__)
+
+# protocol (the fedavg_edge numbering, extended with the async additions)
+MSG_TYPE_S2C_INIT_CONFIG = 1
+MSG_TYPE_S2C_SYNC_MODEL = 2
+MSG_TYPE_C2S_SEND_MODEL = 3
+MSG_TYPE_S2C_FINISH = 4
+MSG_TYPE_C2S_JOIN = 5
+# local control events injected into the server's OWN receive queue (never
+# cross the wire; handling serializes with real messages on the loop)
+MSG_TYPE_LOCAL_PEER_GAVE_UP = 98
+MSG_TYPE_LOCAL_STALL_PROBE = 97
+
+#: the model version a sync message carries / an upload echoes as the
+#: version it TRAINED from — ``server_version - trained_version`` is the
+#: staleness the fold weight decays by
+MSG_ARG_KEY_VERSION = "model_version"
+#: the worker's per-assignment sweep tag: drives the client RNG stream and
+#: the deterministic frontier's canonical order (and dedups uploads —
+#: a retransmit of an already-folded tag can never fold twice)
+MSG_ARG_KEY_TRAIN_TAG = "train_tag"
+#: rank carried by the local control events
+MSG_ARG_KEY_PEER = "peer_rank"
+
+#: frontier-stall probe cadence when no --straggler_deadline_sec is set
+#: (the deadline flag doubles as the probe interval when present: it is
+#: the operator's statement of how long "suspiciously quiet" is). Either
+#: way the effective cadence is floored just above the wire's retry
+#: budget, so a probe never re-sends work the original could still
+#: legitimately deliver.
+DEFAULT_PROBE_SEC = 3.0
+
+
+def _probe_interval(config) -> float:
+    from fedml_tpu.comm.reliable import retry_budget_s
+
+    base = float(getattr(config, "straggler_deadline_sec", None)
+                 or DEFAULT_PROBE_SEC)
+    if getattr(config, "wire_reliable", False):
+        return max(base, 1.25 * retry_budget_s(config))
+    return base
+
+
+class FedBuffAggregator:
+    """Server-side state: the versioned staleness-weighted buffer plus the
+    eval surface (mirrors FedAVGAggregator so launchers/tests read the
+    same attributes: ``variables``, ``test_history``, ``wire_stats``)."""
+
+    def __init__(self, variables, worker_num: int, config, dataset=None,
+                 bundle=None):
+        self.variables = variables
+        self.worker_num = worker_num
+        self.config = config
+        self.dataset = dataset
+        self.buffer = FedBuffBuffer(
+            int(getattr(config, "buffer_k", 4)),
+            float(getattr(config, "buffer_staleness_alpha", 0.5)))
+        self.mode = getattr(config, "buffer_mode", "arrival")
+        self.test_history: list[dict] = []
+        #: uploads dropped by the (worker, tag) exact-once guard — a
+        #: retransmit that crossed a version boundary, a pre-rejoin copy —
+        #: surfaced, never double-folded
+        self.duplicate_uploads = 0
+        #: ejected workers that re-entered (JOIN or upload)
+        self.rejoins = 0
+        self._eval = (make_eval_fn(bundle,
+                                   get_task(dataset.task, dataset.class_num))
+                      if bundle is not None and dataset is not None else None)
+
+    @property
+    def uploads_folded(self) -> int:
+        return self.buffer.folds
+
+    @property
+    def versions_emitted(self) -> int:
+        return self.buffer.versions_emitted
+
+    def test_on_server(self, version_idx: int) -> Optional[dict]:
+        if self._eval is None:
+            return None
+        sums = self._eval(self.variables, self.dataset.test_x,
+                          self.dataset.test_y, self.dataset.test_mask)
+        m = finalize_metrics(jax.tree.map(np.asarray, sums))
+        m["round"] = version_idx
+        self.test_history.append(m)
+        return m
+
+
+class FedBuffEdgeServerManager(ServerManager):
+    """The async server (module docstring): no round barrier, a version
+    every K folds, per-upload replies (arrival) or frontier-ordered
+    replies (deterministic)."""
+
+    def __init__(self, args, comm, rank, size,
+                 aggregator: FedBuffAggregator):
+        super().__init__(args, comm, rank, size)
+        self.aggregator = aggregator
+        self.buffer = aggregator.buffer
+        self.versions_total = int(args.comm_round)
+        self.workers = size - 1
+        cfg = aggregator.config
+        self.deterministic = aggregator.mode == "deterministic"
+        from fedml_tpu.data.sched import CohortScheduler
+
+        cohort = min(args.client_num_per_round, args.client_num_in_total)
+        self.scheduler = CohortScheduler(
+            getattr(cfg, "cohort_policy", "uniform"), cfg.seed,
+            args.client_num_in_total, cohort)
+        self._alive = {w: True for w in range(self.workers)}
+        self._finished = False
+        #: arrival mode: the upload tag expected next per worker (the
+        #: exact-once guard); deterministic mode reads the frontier's
+        self._expected = {w: 0 for w in range(self.workers)}
+        self.frontier = (DeterministicFrontier(range(self.workers))
+                         if self.deterministic else None)
+        #: per-worker assignment send time + ids (pulse attribution)
+        self._sent_at: dict[int, float] = {}
+        self._assignment_map: dict[int, list[int]] = {}
+        #: per-worker LAST SENT assignment content (tag, version, params
+        #: REFERENCE — emissions build new trees, so this is aliasing,
+        #: not copying): probe/JOIN resends must repeat the original
+        #: bytes, or a resend racing its original would hand the worker
+        #: a newer model and make the folded delta arrival-dependent
+        self._last_sent: dict[int, tuple] = {}
+        #: deterministic mode: workers whose fold joined the PENDING buffer
+        #: — their replies flush at the buffer's emission (the only
+        #: canonical point: per-fold or stall-time replies would hand a
+        #: worker a model that depends on arrival timing). With
+        #: buffer_k == workers this is exactly the synchronous broadcast.
+        self._pending_replies: list[int] = []
+        if self.deterministic and self.buffer.k > self.workers:
+            raise ValueError(
+                f"buffer_mode=deterministic needs buffer_k <= workers "
+                f"({self.buffer.k} > {self.workers}): replies flush at "
+                "emission, so a buffer needing more folds than there are "
+                "workers can never fill (DESIGN.md §18)")
+        self._probe_sec = _probe_interval(cfg)
+        self._probe_timer: Optional[threading.Timer] = None
+        self._emit_t0 = time.perf_counter()
+        if self.deterministic:
+            from fedml_tpu.distributed.base_framework import require_injectable
+
+            require_injectable(comm, feature="buffer_mode=deterministic")
+        # ejection oracle: the reliable layer reports the peer whose
+        # retries exhausted; re-enter the event on the server's own loop
+        from fedml_tpu.comm.base import find_layer
+        from fedml_tpu.comm.reliable import ReliableCommManager
+
+        reliable = find_layer(comm, ReliableCommManager)
+        if reliable is not None:
+            reliable.on_gave_up = self._on_gave_up
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self):
+        self.register_message_receive_handlers()
+        for w in range(self.workers):
+            self._send_assignment(w, 0, msg_type=MSG_TYPE_S2C_INIT_CONFIG)
+        self._arm_probe()
+        try:
+            self.com_manager.handle_receive_message()
+        finally:
+            # every exit path (teardown, escalation, error) must drop the
+            # probe timer: a live timer closure would keep this manager —
+            # and its comm stack's registry counter groups — alive past
+            # the federation, leaking wire counters into later runs
+            self._finished = True
+            self._cancel_probe()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_SEND_MODEL, self.handle_upload)
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_JOIN, self.handle_join)
+        self.register_message_receive_handler(
+            MSG_TYPE_LOCAL_PEER_GAVE_UP, self.handle_peer_gave_up)
+        self.register_message_receive_handler(
+            MSG_TYPE_LOCAL_STALL_PROBE, self.handle_stall_probe)
+
+    def _teardown(self):
+        self._finished = True
+        self._cancel_probe()
+        for rank in range(1, self.size):
+            try:
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISH, self.rank, rank))
+            except Exception as e:   # a corpse must not block teardown
+                LOG.warning("FINISH to worker %d failed (%s)", rank - 1, e)
+        self.finish()
+
+    # -- assignments -------------------------------------------------------
+
+    def _assignment(self, worker: int, tag: int) -> list[int]:
+        """Worker ``worker``'s slice of the sweep-``tag`` cohort — pure in
+        (seed, tag, worker): the fixed ``[w::workers]`` deal ignores the
+        alive set, so ejections never reshuffle survivors' data (and with
+        every worker alive it matches fedavg_edge's round-robin deal, the
+        sync-equivalence construction)."""
+        cohort = self.scheduler.sample(int(tag))
+        return [int(c) for c in cohort[worker::self.workers]]
+
+    def _send_assignment(self, worker: int, tag: int,
+                         msg_type: int = MSG_TYPE_S2C_SYNC_MODEL,
+                         resend: bool = False) -> None:
+        """Send worker its (model, version, tag, cohort-slice) assignment.
+        ``resend=True`` (the stall probe, an alive-JOIN un-wedge) repeats
+        the LAST SENT content for that tag verbatim: a resend built from
+        the current state could carry a newer emitted model than the
+        original, and which copy the worker trains from would then be
+        arrival-dependent — the exact-once guard dedups the uploads, but
+        their payloads must be identical for deterministic replay."""
+        cached = self._last_sent.get(worker)
+        if resend and cached is not None and cached[0] == int(tag):
+            _tag, version, params = cached
+        else:
+            version, params = self.buffer.version, self.aggregator.variables
+        ids = self._assignment(worker, tag)
+        m = Message(msg_type, self.rank, worker + 1)
+        m.add_params(MSG_ARG_KEY_MODEL_PARAMS, params)
+        m.add_params(MSG_ARG_KEY_CLIENT_INDEX, ids)
+        m.add_params(MSG_ARG_KEY_VERSION, version)
+        m.add_params(MSG_ARG_KEY_TRAIN_TAG, int(tag))
+        try:
+            self.send_message(m)
+        except Exception as e:
+            # the transport itself declared the peer gone (dead gRPC
+            # endpoint): eject — via the injected control event so the
+            # ejection serializes AFTER the handler currently running
+            # (mid-drain re-entry would corrupt the frontier walk)
+            LOG.warning("assignment to worker %d failed (%s)", worker, e)
+            self._on_gave_up(worker + 1, m)
+            return
+        self._last_sent[worker] = (int(tag), version, params)
+        self._sent_at[worker] = time.perf_counter()
+        self._assignment_map[worker] = ids
+
+    # -- upload path -------------------------------------------------------
+
+    def handle_upload(self, msg: Message) -> None:
+        if self._finished:
+            return
+        w = msg.get_sender_id() - 1
+        tag = int(msg.get(MSG_ARG_KEY_TRAIN_TAG))
+        trained_v = int(msg.get(MSG_ARG_KEY_VERSION))
+        item = (msg.get(MSG_ARG_KEY_MODEL_DELTA),
+                float(msg.get(MSG_ARG_KEY_NUM_SAMPLES)), trained_v)
+        if not self._alive.get(w, False):
+            # an upload from a presumed-dead worker IS its rejoin — and
+            # unlike the sync deadline path, the payload is USED: staleness
+            # weighting exists exactly so late work still counts
+            LOG.info("worker %d rejoined via upload (tag %d)", w, tag)
+            self._alive[w] = True
+            self.aggregator.rejoins += 1
+            if self.deterministic and self.frontier.next_tag(w) is None:
+                self.frontier.admit(w, tag)
+        if self.deterministic:
+            if not self.frontier.offer(w, tag, item):
+                self.aggregator.duplicate_uploads += 1
+                return
+            self._advance()
+        else:
+            if tag != self._expected.get(w):
+                self.aggregator.duplicate_uploads += 1
+                return
+            self._expected[w] = tag + 1
+            self._fold(w, tag, item)
+            if not self._finished:
+                self._send_assignment(w, tag + 1)
+
+    def _fold(self, worker: int, tag: int, item) -> None:
+        delta, n, trained_v = item
+        rec = self.buffer.fold(delta, n, trained_v)
+        if self.deterministic:
+            self._pending_replies.append(worker)
+        from fedml_tpu.obs import pulse_if_enabled
+
+        pulse = pulse_if_enabled()
+        if pulse is not None:
+            sent = self._sent_at.get(worker)
+            pulse.observe_upload(
+                self._assignment_map.get(worker) or [],
+                self.buffer.version,
+                train_ms=(None if sent is None
+                          else (time.perf_counter() - sent) * 1e3),
+                upload_bytes=float(sum(
+                    getattr(leaf, "nbytes", 8)
+                    for leaf in jax.tree.leaves(delta))),
+                staleness=rec["staleness"])
+        if self.buffer.ready:
+            self._emit()
+
+    def _advance(self) -> None:
+        """Deterministic mode: drain the frontier in canonical order.
+        Replies flush inside :meth:`_emit` — a worker folded into buffer
+        ``b`` hears back exactly when ``b`` emits, carrying the version its
+        own buffer produced. That is the ONE reply schedule that is both a
+        pure function of the fold sequence (per-fold or stall-time replies
+        would hand out a model that depends on arrival timing) and, at
+        ``buffer_k == workers``, exactly the synchronous broadcast
+        (sync-equivalence). Liveness needs ``buffer_k <= admitted``
+        (enforced at init, re-checked at ejection): each emission releases
+        the workers whose uploads the NEXT K folds require."""
+        for w, tag, item in self.frontier.drain():
+            self._fold(w, tag, item)
+            if self._finished:
+                return
+        self._arm_probe()
+
+    # -- version emission --------------------------------------------------
+
+    def _emit(self) -> None:
+        params, rec = self.buffer.emit(self.aggregator.variables)
+        self.aggregator.variables = params
+        v_idx = self.buffer.versions_emitted - 1   # 0-based, like rounds
+        metrics = None
+        if (v_idx % self.args.frequency_of_the_test == 0
+                or v_idx == self.versions_total - 1):
+            metrics = self.aggregator.test_on_server(v_idx)
+        self.scheduler.notify_round_done(v_idx)
+        from fedml_tpu.obs import pulse_if_enabled
+
+        pulse = pulse_if_enabled()
+        if pulse is not None:
+            # one pulse snapshot per EMITTED VERSION — the async round
+            # boundary. server_version + the per-version fold count ride
+            # the wire lane; version lag feeds the staleness sketch per
+            # fold (observe_upload), so the watchdog's version_lag rule
+            # reads this round's delta p99.
+            pulse.on_round(
+                v_idx, source="fedbuff_server",
+                loss=(float(metrics["loss"]) if metrics
+                      and metrics.get("loss") is not None else None),
+                round_ms=(time.perf_counter() - self._emit_t0) * 1e3,
+                extra={"server_version": self.buffer.version,
+                       "uploads": rec["folds"],
+                       "version_lag_max": rec["staleness_max"],
+                       "workers_alive": sum(
+                           1 for a in self._alive.values() if a)})
+        self._emit_t0 = time.perf_counter()
+        if self.buffer.versions_emitted >= self.versions_total:
+            self._teardown()
+            return
+        if self.deterministic:
+            # release the emitted buffer's workers (module docstring: the
+            # canonical reply point); an ejected corpse is skipped — it
+            # would not read the reply anyway
+            released, self._pending_replies = self._pending_replies, []
+            for w in released:
+                if self._alive.get(w, False):
+                    self._send_assignment(w, self.frontier.next_tag(w))
+
+    # -- ejection / liveness -----------------------------------------------
+
+    def _on_gave_up(self, receiver: int, msg: Message) -> None:
+        """Reliable-layer hook (retransmit thread): re-enter as a local
+        control event so ejection serializes with message handling."""
+        if self._finished or receiver == 0:
+            return
+        m = Message(MSG_TYPE_LOCAL_PEER_GAVE_UP, self.rank, self.rank)
+        m.add_params(MSG_ARG_KEY_PEER, int(receiver))
+        try:
+            self.com_manager.inject_local(m)
+        except Exception as e:   # loop already torn down
+            LOG.debug("gave-up injection failed (%s)", e)
+
+    def handle_peer_gave_up(self, msg: Message) -> None:
+        if self._finished:
+            return
+        self._eject(int(msg.get(MSG_ARG_KEY_PEER)) - 1)
+
+    def _eject(self, worker: int) -> None:
+        if not self._alive.get(worker, False):
+            return
+        LOG.warning("worker %d ejected (gave-up/unreachable); its pending "
+                    "slots stop gating version emission", worker)
+        self._alive[worker] = False
+        if self.deterministic:
+            self.frontier.eject(worker)
+            # drop any reply the pending buffer owes it: if a JOIN
+            # re-admits this worker before the buffer emits, the JOIN's
+            # fresh assignment must be the ONLY one for its tag — a stale
+            # release at emission would send a second, payload-different
+            # copy and make the folded delta arrival-dependent
+            self._pending_replies = [w for w in self._pending_replies
+                                     if w != worker]
+        if not any(self._alive.values()):
+            LOG.error("every worker is dead; tearing down with %d/%d "
+                      "versions emitted", self.buffer.versions_emitted,
+                      self.versions_total)
+            self._teardown()
+            return
+        if self.deterministic:
+            if len(self.frontier.admitted) < self.buffer.k:
+                # fewer admitted workers than the buffer needs folds: the
+                # pending buffer can never fill (DESIGN.md §18 degradation
+                # table) — tear down instead of stalling forever, like the
+                # sync path's all-dead deadline bound
+                LOG.error(
+                    "admitted workers (%d) dropped below buffer_k (%d); "
+                    "tearing down with %d/%d versions emitted",
+                    len(self.frontier.admitted), self.buffer.k,
+                    self.buffer.versions_emitted, self.versions_total)
+                self._teardown()
+                return
+            self._advance()   # the corpse may have been the frontier head
+
+    def handle_join(self, msg: Message) -> None:
+        """A (re)connecting worker announces itself. An ejected worker is
+        re-admitted at the CURRENT sweep with a fresh assignment; its
+        in-flight pre-crash upload, if it ever lands, is absorbed by the
+        exact-once guard. A JOIN from a worker still marked ALIVE is a
+        starvation signal, not noise: fedbuff clients only JOIN after
+        prolonged silence (keepalive) or a crash_restart revival, so in
+        arrival mode the server re-sends the pending assignment — the
+        idempotent un-wedge for an upload/assignment lost during an
+        outage the gave-up oracle never saw (the worker owed the server
+        nothing unacked, so it was never ejected). Deterministic mode
+        must NOT answer arrival-timed JOINs with a model (it would leave
+        the canonical reply schedule); its frontier-stall probe already
+        re-sends the head assignment instead."""
+        w = msg.get_sender_id() - 1
+        if self._finished:
+            return
+        if self._alive.get(w, False):
+            if not self.deterministic:
+                LOG.info("alive worker %d JOINed (starved/revived); "
+                         "re-sending its pending assignment tag %d",
+                         w, self._expected[w])
+                self._send_assignment(w, self._expected[w], resend=True)
+            return
+        self._alive[w] = True
+        self.aggregator.rejoins += 1
+        if self.deterministic:
+            tag = max([self.frontier.next_tag(x)
+                       for x in self.frontier.admitted] or [0])
+            self.frontier.admit(w, tag)
+        else:
+            tag = self._expected[w]
+        LOG.info("worker %d rejoined via JOIN; re-admitted at tag %d", w, tag)
+        self._send_assignment(w, tag)
+
+    # -- frontier stall probe ----------------------------------------------
+
+    def _arm_probe(self) -> None:
+        """Deterministic mode: while the frontier waits on a slot, probe
+        its owner on a timer by RE-SENDING its pending assignment. To a
+        live worker the resend is idempotent — a duplicate upload is
+        absorbed by the exact-once guard, and a worker starved by an
+        abandoned (gave-up) assignment is un-wedged; to a corpse the
+        resend's retries exhaust and the gave-up path ejects it — version
+        emission never stalls forever either way. The probe cadence is
+        floored above the wire retry budget (``_probe_interval``), so a
+        resend never races an original that could still deliver."""
+        if not self.deterministic or self._finished:
+            return
+        self._cancel_probe()
+        head = self.frontier.head()
+        if head is None:
+            return
+        m = Message(MSG_TYPE_LOCAL_STALL_PROBE, self.rank, self.rank)
+        m.add_params(MSG_ARG_KEY_PEER, head[1] + 1)
+        m.add_params(MSG_ARG_KEY_TRAIN_TAG, head[0])
+
+        def fire():
+            try:
+                self.com_manager.inject_local(m)
+            except Exception as e:
+                LOG.debug("stall-probe injection failed (%s)", e)
+
+        t = threading.Timer(self._probe_sec, fire)
+        t.daemon = True
+        t.start()
+        self._probe_timer = t
+
+    def _cancel_probe(self) -> None:
+        if self._probe_timer is not None:
+            self._probe_timer.cancel()
+            self._probe_timer = None
+
+    def handle_stall_probe(self, msg: Message) -> None:
+        if self._finished or not self.deterministic:
+            return
+        head = self.frontier.head()
+        probed = (int(msg.get(MSG_ARG_KEY_TRAIN_TAG)),
+                  int(msg.get(MSG_ARG_KEY_PEER)) - 1)
+        if head == probed and self._alive.get(probed[1], False):
+            LOG.info("frontier stalled on worker %d (tag %d) for %.1fs; "
+                     "re-sending its assignment", probed[1], probed[0],
+                     self._probe_sec)
+            self._send_assignment(probed[1], probed[0], resend=True)
+        self._arm_probe()
+
+
+class FedBuffEdgeClientManager(ClientManager):
+    """The async worker: stateless train-on-assignment (reusing the sync
+    path's FedAVGTrainer — the tag drives the same (seed, tag, client) RNG
+    stream fedavg_edge uses, which is what makes sync-equivalence exact),
+    uploading the update DELTA against the version it trained from. A
+    keepalive timer JOINs after prolonged silence, and a chaos
+    crash_restart revival JOINs immediately (``on_restart``) — the
+    recovery paths the crash_restart fate exists to test."""
+
+    def __init__(self, args, comm, rank, size, trainer: FedAVGTrainer,
+                 root_key):
+        super().__init__(args, comm, rank, size)
+        self.trainer = trainer
+        self.root_key = root_key
+        #: silence threshold before a JOIN re-announce; generous multiple
+        #: of the server's probe cadence so healthy waits don't JOIN-spam
+        self._keepalive_s = max(2.0 * _probe_interval(trainer.config), 3.0)
+        self._keepalive: Optional[threading.Timer] = None
+        #: serializes arm/cancel between the receive loop and a firing
+        #: timer's own re-arm — an unlocked overwrite would orphan a live
+        #: timer chain that keeps JOINing untracked
+        self._ka_lock = threading.Lock()
+        self._done = False
+
+    def run(self):
+        self.register_message_receive_handlers()
+        from fedml_tpu.comm.chaos import find_chaos
+
+        chaos = find_chaos(self.com_manager)
+        if chaos is not None:
+            chaos.on_restart = self._send_join
+        self._arm_keepalive()
+        try:
+            self.com_manager.handle_receive_message()
+        finally:
+            # the receive loop can exit WITHOUT a FINISH (permanent
+            # crash-stop kills the loop directly, errors unwind): the
+            # keepalive must die with it, or it re-arms forever — JOINing
+            # a dead federation every cycle and keeping this worker's
+            # whole comm stack (and its registry counters) alive
+            self._done = True
+            self._cancel_keepalive()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_INIT_CONFIG, self.handle_assignment)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_SYNC_MODEL, self.handle_assignment)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_FINISH, self.handle_finish)
+
+    def _send_join(self) -> None:
+        if self._done:
+            return
+        try:
+            self.send_message(Message(MSG_TYPE_C2S_JOIN, self.rank, 0))
+        except Exception as e:   # best-effort: retried by the next timer
+            LOG.debug("rank %d JOIN failed (%s)", self.rank, e)
+
+    def _arm_keepalive(self) -> None:
+        def fire():
+            self._send_join()
+            self._arm_keepalive()
+
+        with self._ka_lock:
+            if self._keepalive is not None:
+                self._keepalive.cancel()
+                self._keepalive = None
+            if self._done:
+                return
+            t = threading.Timer(self._keepalive_s, fire)
+            t.daemon = True
+            t.start()
+            self._keepalive = t
+
+    def _cancel_keepalive(self) -> None:
+        with self._ka_lock:
+            if self._keepalive is not None:
+                self._keepalive.cancel()
+                self._keepalive = None
+
+    def handle_finish(self, msg: Message) -> None:
+        self._done = True
+        self._cancel_keepalive()
+        self.finish()
+
+    def handle_assignment(self, msg: Message) -> None:
+        # keepalive measures SERVER silence while this worker is idle —
+        # not its own training time: cancel for the (synchronous,
+        # receive-loop-thread) training below and re-arm once the upload
+        # is away, or any assignment training longer than the interval
+        # would JOIN mid-train and earn a duplicate retrain of every tag
+        self._cancel_keepalive()
+        tag = int(msg.get(MSG_ARG_KEY_TRAIN_TAG))
+        version = int(msg.get(MSG_ARG_KEY_VERSION))
+        variables = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        self.trainer.update_dataset(msg.get(MSG_ARG_KEY_CLIENT_INDEX))
+        new_vars, n = self.trainer.train(variables, tag, self.root_key)
+        from fedml_tpu.core.pytree import tree_sub
+
+        delta = tree_sub(new_vars, jax.tree.map(np.asarray, variables))
+        out = Message(MSG_TYPE_C2S_SEND_MODEL, self.rank, 0)
+        out.add_params(MSG_ARG_KEY_MODEL_DELTA, delta)
+        out.add_params(MSG_ARG_KEY_NUM_SAMPLES, n)
+        out.add_params(MSG_ARG_KEY_TRAIN_TAG, tag)
+        out.add_params(MSG_ARG_KEY_VERSION, version)
+        self.send_message(out)
+        self._arm_keepalive()   # idle again: the silence clock starts now
+
+
+def build_fedbuff_rank(dataset, config, rank: int, world_size: int, comm,
+                       bundle=None, root_key=None, aggregator=None):
+    """Build ONE rank's manager (mirrors fedavg_edge.build_edge_rank:
+    model init + federation RNG derive from ``config.seed``, so separate
+    processes construct identical initial state)."""
+    from fedml_tpu.core.rng import seed_everything
+
+    if bundle is None:
+        bundle = create_model(
+            config.model, dataset.class_num,
+            input_shape=dataset.train_x.shape[2:] or None)
+    if root_key is None:
+        root_key = seed_everything(config.seed)
+    args = _edge_args(config, dataset)
+    if rank == 0:
+        if aggregator is None:
+            aggregator = FedBuffAggregator(
+                bundle.init(root_key), world_size - 1, config,
+                dataset=dataset, bundle=bundle)
+        return FedBuffEdgeServerManager(args, comm, 0, world_size,
+                                        aggregator)
+    trainer = FedAVGTrainer(dataset, bundle, config)
+    return FedBuffEdgeClientManager(args, comm, rank, world_size, trainer,
+                                    root_key)
+
+
+def run_fedbuff_edge(dataset, config, worker_num: int,
+                     wire_roundtrip: bool = True, comm_factory=None,
+                     timeout: float = 300.0, profile_snapshot=None):
+    """In-process launch: 1 async server + ``worker_num`` workers over the
+    local transport (or a real one via ``comm_factory`` — the chaos/grpc
+    tests' path). ``config.comm_round`` is the number of model VERSIONS to
+    emit. ``profile_snapshot`` freezes the fedsched scheduling signal
+    (``set_static_profile``) for the speed/fair policies' deterministic
+    mode. Returns the server's aggregator (final model + per-version test
+    history + fold accounting + wire stats)."""
+    from fedml_tpu.core.rng import seed_everything
+    from fedml_tpu.obs import configure_from
+
+    configure_from(config)
+    bundle = create_model(config.model, dataset.class_num,
+                          input_shape=dataset.train_x.shape[2:] or None)
+    root_key = seed_everything(config.seed)
+    size = worker_num + 1
+    aggregator = FedBuffAggregator(bundle.init(root_key), worker_num,
+                                   config, dataset=dataset, bundle=bundle)
+
+    def make(rank, comm):
+        mgr = build_fedbuff_rank(dataset, config, rank, size, comm,
+                                 bundle=bundle, root_key=root_key,
+                                 aggregator=aggregator)
+        if rank == 0 and profile_snapshot is not None:
+            mgr.scheduler.set_static_profile(profile_snapshot)
+        return mgr
+
+    from fedml_tpu.comm.reliable import wire_wrap_factory
+
+    managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip,
+                         comm_factory=comm_factory, timeout=timeout,
+                         codec=getattr(config, "wire_codec", "raw"),
+                         wrap=wire_wrap_factory(config))
+    # Release every rank's wire stack explicitly: a crash-stopped rank's
+    # receive loop exits WITHOUT reaching finish(), and an un-stopped
+    # reliable layer's retransmit thread is an immortal reference to its
+    # registry counter groups — the crash's gave_up counts would haunt
+    # every later federation's wire snapshots in this process. Idempotent
+    # for the ranks that did finish.
+    for m in managers:
+        try:
+            m.com_manager.stop_receive_message()
+        except Exception:   # already torn down
+            pass
+    from fedml_tpu.utils.metrics import merge_wire_stats
+
+    aggregator.wire_stats = merge_wire_stats(
+        [m.com_manager for m in managers])
+    anomalies = ("wire/retransmits", "wire/retransmit_errors",
+                 "wire/gave_up", "wire/dup_dropped")
+    if any(aggregator.wire_stats.get(k, 0) for k in anomalies) or any(
+            k.startswith("chaos/") and v
+            for k, v in aggregator.wire_stats.items()):
+        LOG.info("wire stats: %s", aggregator.wire_stats)
+    return aggregator

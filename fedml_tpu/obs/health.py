@@ -35,6 +35,18 @@ rule                severity  fires when
                               ``max_clients`` cap this round — the store is
                               silently blind to part of the cohort (raise
                               the cap or fix the id space)
+``version_lag``     warn      THIS round's staleness-sketch delta p99 (the
+                              per-contribution versions-behind lane fedbuff
+                              writes) reaches ``--health_version_lag``;
+                              ESCALATES TO CRITICAL when the p99 has grown
+                              strictly monotonically for
+                              :data:`VERSION_LAG_MONOTONIC_N` consecutive
+                              snapshots that carry the lane — clients
+                              falling ever further behind the emitted
+                              version is the buffered-async divergence
+                              signature (a bounded-but-high lag is a warn;
+                              an unbounded one means the staleness decay
+                              is no longer keeping the fold mass current)
 ==================  ========  =============================================
 
 Counter rules are DELTA rules: the watchdog tracks the previous round's
@@ -58,6 +70,11 @@ from typing import Optional
 _SEVERITY = {"ok": 0, "warn": 1, "critical": 2}
 _STATES = {v: k for k, v in _SEVERITY.items()}
 
+#: consecutive strictly-increasing staleness-delta p99 snapshots before the
+#: version_lag rule escalates warn -> critical (the monotonic-divergence
+#: signature; a noisy-but-bounded lag keeps warning instead)
+VERSION_LAG_MONOTONIC_N = 4
+
 
 class FederationHealthError(RuntimeError):
     """Raised by escalate mode on a critical health event; carries the
@@ -76,13 +93,18 @@ class HealthWatchdog:
 
     def __init__(self, *, loss_limit: float = 0.0,
                  stall_sec: Optional[float] = None, stale_spike: int = 8,
-                 skew: float = 4.0, escalate: bool = False,
+                 skew: float = 4.0, version_lag: float = 0.0,
+                 escalate: bool = False,
                  history: int = 256):
         self.loss_limit = float(loss_limit or 0.0)
         self.stall_sec = None if not stall_sec else float(stall_sec)
         self.stale_spike = int(stale_spike or 0)
         self.skew = float(skew or 0.0)
+        self.version_lag = float(version_lag or 0.0)
         self.escalate = bool(escalate)
+        #: last staleness-delta p99 + current monotonic-growth streak
+        self._lag_prev: Optional[float] = None
+        self._lag_growth = 0
         #: worst severity ever observed (sticky; fedtop's header state)
         self.state = "ok"
         #: bounded event history (a weeks-long run keeps the latest N)
@@ -157,6 +179,32 @@ class HealthWatchdog:
                 add("straggler_skew", "warn",
                     f"{basis} {ptail / p50:.2f} exceeds "
                     f"health_skew {self.skew:g}")
+        if self.version_lag > 0.0 and profile:
+            # fedbuff divergence watch: THIS round's staleness-sketch delta
+            # p99 (versions behind per contribution). Snapshots without the
+            # lane (no folds this round) leave the streak untouched — a
+            # quiet round is not evidence the lag stopped growing.
+            sk = (profile.get("sketches") or {}).get("staleness") or {}
+            p99 = sk.get("p99")
+            if p99 is not None and sk.get("count", 0) > 0:
+                if self._lag_prev is not None and p99 > self._lag_prev:
+                    self._lag_growth += 1
+                elif self._lag_prev is not None:
+                    # equal OR lower resets: the contract is STRICTLY
+                    # monotonic growth for N consecutive snapshots — a
+                    # plateau (the healthy steady-state lag, and the
+                    # common case under ~1% sketch quantization) must not
+                    # park an old streak one noise uptick from critical
+                    self._lag_growth = 0
+                self._lag_prev = float(p99)
+                if p99 >= self.version_lag:
+                    monotone = self._lag_growth >= VERSION_LAG_MONOTONIC_N
+                    add("version_lag",
+                        "critical" if monotone else "warn",
+                        f"staleness delta p99 {p99:g} versions >= "
+                        f"health_version_lag {self.version_lag:g}"
+                        + (f"; grew {self._lag_growth} snapshots in a row "
+                           "(monotonic divergence)" if monotone else ""))
         if profile:
             cur_dropped = int(profile.get("dropped_ids", 0) or 0)
             delta = cur_dropped - self._prev_dropped

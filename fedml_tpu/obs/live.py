@@ -185,10 +185,14 @@ class PulsePlane:
 
     def observe_upload(self, client_ids, round_idx: int, *,
                        train_ms: Optional[float] = None,
-                       upload_bytes: Optional[float] = None) -> None:
+                       upload_bytes: Optional[float] = None,
+                       staleness: float = 0.0) -> None:
         """Edge-server per-upload feed (broadcast→aggregate path): attribute
         the worker's observed round latency + payload bytes to its assigned
-        logical clients."""
+        logical clients. ``staleness`` is the contribution's version lag on
+        the staleness sketch lane — 0 for a sync round's on-time upload
+        (the default), ``server_version - trained_version`` for a fedbuff
+        fold (the lane the watchdog's version_lag rule reads)."""
         ids = np.atleast_1d(np.asarray(client_ids, np.int64))
         if ids.size == 0:
             return
@@ -199,11 +203,10 @@ class PulsePlane:
             self.profiler.observe(ids, round_idx, train_ms=train_ms,
                                   upload_bytes=per_client)
             # sketch lanes record the UPLOAD-granular values (one sample per
-            # contribution, not per assigned logical client) — and an
-            # accepted upload is 0 rounds behind on the staleness lane
+            # contribution, not per assigned logical client)
             self.profiler.observe_wire(upload_ms=train_ms,
                                        payload_bytes=upload_bytes,
-                                       staleness=0.0)
+                                       staleness=float(staleness))
 
     def observe_stale(self, rounds_behind: int) -> None:
         """Stale-contribution feed (the deadline-closed late-upload path):
@@ -418,7 +421,7 @@ def configure(path: Optional[str] = None,
               capacity_hint: int = 1024, sketch_alpha: float = 0.01,
               loss_limit: float = 0.0,
               stall_sec: Optional[float] = None, stale_spike: int = 8,
-              skew: float = 4.0,
+              skew: float = 4.0, version_lag: float = 0.0,
               escalate: bool = False) -> Optional[PulsePlane]:
     """(Re)build the process-wide plane. ``configure(None)`` disables it;
     ``configure(None, profile_store=True)`` builds a profiler-only plane
@@ -437,7 +440,7 @@ def configure(path: Optional[str] = None,
                 if profile_store else None)
     watchdog = HealthWatchdog(loss_limit=loss_limit, stall_sec=stall_sec,
                               stale_spike=stale_spike, skew=skew,
-                              escalate=escalate)
+                              version_lag=version_lag, escalate=escalate)
     # delta rules start from the registry's CURRENT totals: an earlier
     # federation's wire anomalies in this process are not this run's
     watchdog.baseline(default_registry().snapshot("wire"))
@@ -471,6 +474,7 @@ def configure_from(config) -> bool:
               stall_sec=getattr(config, "health_stall_sec", None),
               stale_spike=getattr(config, "health_stale_spike", 8),
               skew=getattr(config, "health_skew", 4.0),
+              version_lag=getattr(config, "health_version_lag", 0.0),
               escalate=getattr(config, "health_escalate", False))
     return True
 

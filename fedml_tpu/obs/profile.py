@@ -31,10 +31,11 @@ fairness question ("which clients never get sampled?") needs counts at the
   the *distributions* the means hide — ``train_ms`` (per-client walls),
   ``upload_ms`` (broadcast→upload latency per contribution),
   ``payload_bytes`` (per contribution), and ``staleness`` (rounds-behind
-  per contribution; fed from the stale-upload path today, the lane
-  FedBuff's version lag will write into). Fixed-memory and mergeable
-  across hosts; their measured bytes count into :attr:`nbytes` so the
-  store's bound stays honest.
+  per contribution; the sync paths feed it from the stale-upload drop
+  path, and the fedbuff async server writes every fold's version lag —
+  the signal the watchdog's ``version_lag`` rule reads). Fixed-memory and
+  mergeable across hosts; their measured bytes count into :attr:`nbytes`
+  so the store's bound stays honest.
 
 Thread-safe (the edge server's handler thread and the sim loop may share
 one process-wide profiler); EMA uses a fixed ``ema_alpha`` so a client's
@@ -182,9 +183,9 @@ class ClientProfiler:
         server records each upload's broadcast→upload latency and decoded
         payload bytes once per upload (not once per assigned logical
         client), and every contribution's rounds-behind — 0 for an on-time
-        upload, the deadline-closed lag for a stale one. FedBuff-style
-        aggregation will write its version lag into the same ``staleness``
-        lane."""
+        upload, the deadline-closed lag for a stale one, the fold's
+        version lag for a fedbuff contribution (same lane, one merged
+        distribution)."""
         with self._lock:
             if upload_ms is not None:
                 self.sketches["upload_ms"].add(upload_ms)

@@ -89,11 +89,26 @@ METRICS = {
         lambda j: _sketch(j, "train_ms", "p99"), "p99 train-ms", False),
     "p99_staleness": (
         lambda j: _sketch(j, "staleness", "p99"), "p99 staleness", False),
+    # fedbuff (ISSUE 14): the async-vs-sync A/B under injected stragglers.
+    # async clients/s is higher-is-better and gates like the sync column;
+    # version-lag p99 is the staleness trajectory — context, never gated
+    # (a lag change reads with the buffer_k/delay context, not as a
+    # regression). Absent on pre-ISSUE-14 artifacts (chained .get()s
+    # return None; missing keys never flake the gate).
+    "fedbuff_async_clients_per_sec": (
+        lambda j: ((j.get("crossdevice") or {}).get("fedbuff") or {})
+        .get("async_clients_per_sec"),
+        "async clients/s", True),
+    "fedbuff_version_lag_p99": (
+        lambda j: ((j.get("crossdevice") or {}).get("fedbuff") or {})
+        .get("version_lag_p99"),
+        "version lag p99", False),
     # fedsched (ISSUE 13): the cross-device block's cohort size and cohort
     # policy — context columns for the clients/s trajectory (the r06 jump
     # reads as "1000-client scheduled cohorts", not as free speed). Absent
     # on r01-r05 artifacts; `policy` is a STRING column (trajectory-only —
-    # strings never reach the drop gate).
+    # strings never reach the drop gate). They stay LAST: the
+    # committed-series golden pins the r06 row ending on its policy string.
     "xdev_cohort": (
         lambda j: (j.get("crossdevice") or {}).get("clients_per_round"),
         "cohort size", False),

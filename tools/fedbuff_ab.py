@@ -1,0 +1,192 @@
+"""Multi-seed sync-vs-async (fedbuff) A/B sweep with a determinism gate.
+
+For every seed this tool runs, on one small edge federation (local
+transport, threads):
+
+1. **Deterministic replay gate**: the same fedbuff federation
+   (``--buffer_mode deterministic``) twice under seeded drop/dup/delay
+   chaos — final weights and per-version histories must be BIT-IDENTICAL
+   (the ISSUE-14 contract: the whole async schedule is a pure function of
+   ``(seed, chaos_seed)``). Any mismatch exits non-zero.
+2. **Sync-vs-async throughput**: fedavg_edge rounds vs fedbuff arrival
+   mode under the same injected per-message delay (the WAN straggler
+   model) — clients/s per arm and the async/sync ratio are reported, with
+   the version-lag p99 the staleness weighting absorbed.
+
+Every run executes under a watchdog: a wedged frontier, a lost FINISH or
+a deadlocked teardown surfaces as a reported hang (non-zero exit), never
+a silent CI stall — this slots next to tools/chaos_sweep.py and
+tools/xdev_ab.py.
+
+Usage: python tools/fedbuff_ab.py [out.json] [--seeds N] [--versions V]
+                                  [--workers W] [--delay MS] [--timeout S]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _arg(argv, flag, default, cast=float):
+    if flag in argv:
+        return cast(argv[argv.index(flag) + 1])
+    return default
+
+
+def _run_with_watchdog(fn, timeout: float):
+    """fn() on a daemon thread; (result, error_str). A hang cannot wedge
+    the sweep — the daemon thread dies with the process."""
+    out: dict = {}
+
+    def target():
+        try:
+            out["result"] = fn()
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            out["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        return None, f"hang: run exceeded {timeout:.0f}s watchdog"
+    return out.get("result"), out.get("error")
+
+
+def main(argv):
+    out_path = argv[0] if argv and not argv[0].startswith("-") else None
+    seeds = _arg(argv, "--seeds", 3, int)
+    versions = _arg(argv, "--versions", 4, int)
+    workers = _arg(argv, "--workers", 3, int)
+    delay_ms = _arg(argv, "--delay", 60.0)
+    timeout = _arg(argv, "--timeout", 120.0)
+
+    import time
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+    from fedml_tpu.distributed.fedbuff_edge import run_fedbuff_edge
+
+    cohort = workers * 2
+    results, failed = [], 0
+    warmed = False
+    for seed in range(seeds):
+        ds = make_synthetic_classification(
+            f"fedbuff-ab-{seed}", (16,), 5, cohort, records_per_client=20,
+            partition_method="hetero", partition_alpha=0.5, batch_size=8,
+            seed=seed)
+        if not warmed:
+            # absorb the jitted local-train compile OUTSIDE the gated
+            # chaos runs: a multi-second compile inside a worker handler
+            # stalls its receive loop past the fast gave-up budget and
+            # reads as a dead peer (same shapes across seeds — one warm
+            # federation serves the whole sweep)
+            warm = FedConfig(
+                model="lr", dataset="fedbuff-ab", client_num_in_total=cohort,
+                client_num_per_round=cohort, comm_round=1, batch_size=8,
+                epochs=1, lr=0.1, seed=seed, frequency_of_the_test=10_000,
+                device_data="off")
+            run_fedavg_edge(ds, warm, worker_num=workers)
+            warmed = True
+
+        def cfg(**kw):
+            base = dict(
+                model="lr", dataset="fedbuff-ab",
+                client_num_in_total=cohort, client_num_per_round=cohort,
+                comm_round=versions, batch_size=8, epochs=1, lr=0.1,
+                seed=seed, frequency_of_the_test=1, device_data="off",
+                # fast gave-up schedule: dead-peer detection in ~1.4 s
+                wire_retry_base_s=0.02, wire_retry_max=6)
+            base.update(kw)
+            return FedConfig(**base)
+
+        def det_run():
+            agg = run_fedbuff_edge(
+                ds, cfg(buffer_k=workers, buffer_mode="deterministic",
+                        wire_reliable=True, chaos_drop=0.2, chaos_dup=0.1,
+                        chaos_delay_ms=20, chaos_seed=seed + 100),
+                worker_num=workers)
+            return ([np.asarray(l) for l in jax.tree.leaves(agg.variables)],
+                    [h["loss"] for h in agg.test_history],
+                    agg.uploads_folded)
+
+        rec = {"seed": seed, "ok": False}
+        a, err = _run_with_watchdog(det_run, timeout)
+        if err is None:
+            b, err = _run_with_watchdog(det_run, timeout)
+        if err is not None:
+            rec["error"] = err
+        elif not all(np.array_equal(x, y) for x, y in zip(a[0], b[0])):
+            rec["error"] = "deterministic replay: final weights differ"
+        elif a[1] != b[1]:
+            rec["error"] = "deterministic replay: version histories differ"
+        elif a[2] != workers * versions:
+            rec["error"] = (f"fold accounting: {a[2]} folds != "
+                            f"{workers * versions} (exact-once broken)")
+        else:
+            rec["replay"] = {"folds": a[2], "final_loss": a[1][-1]}
+            # sync-vs-async throughput under the same injected delay
+            chaos = dict(chaos_delay_ms=delay_ms, chaos_seed=seed + 200)
+
+            def sync_run():
+                t0 = time.perf_counter()
+                run_fedavg_edge(ds, cfg(**chaos), worker_num=workers)
+                return versions * cohort / (time.perf_counter() - t0)
+
+            def async_run():
+                t0 = time.perf_counter()
+                agg = run_fedbuff_edge(
+                    ds, cfg(buffer_k=workers, buffer_mode="arrival",
+                            **chaos), worker_num=workers)
+                stal = [r["staleness"] for r in agg.buffer.fold_log]
+                cps = (agg.uploads_folded * (cohort // workers)
+                       / (time.perf_counter() - t0))
+                return cps, float(np.percentile(stal, 99)) if stal else None
+
+            s, err = _run_with_watchdog(sync_run, timeout)
+            if err is None:
+                ar, err = _run_with_watchdog(async_run, timeout)
+            if err is not None:
+                rec["error"] = err
+            else:
+                rec["ok"] = True
+                rec["ab"] = {
+                    "sync_clients_per_sec": round(s, 2),
+                    "async_clients_per_sec": round(ar[0], 2),
+                    "async_vs_sync": round(ar[0] / s, 3),
+                    "version_lag_p99": ar[1],
+                }
+        if not rec["ok"]:
+            failed += 1
+            print(f"seed {seed}: FAIL ({rec['error']})", file=sys.stderr)
+        else:
+            print(f"seed {seed}: ok (async/sync "
+                  f"{rec['ab']['async_vs_sync']}x)")
+        results.append(rec)
+
+    summary = {"seeds": seeds, "failed": failed, "versions": versions,
+               "workers": workers, "delay_ms": delay_ms,
+               "results": results}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps({"seeds": seeds, "failed": failed}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    rc = main(sys.argv[1:])
+    # hard exit: a genuinely wedged run leaks daemon federation threads
+    # whose teardown would otherwise block interpreter exit — the exact
+    # CI stall the watchdog exists to prevent
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

@@ -161,9 +161,11 @@ def _rates(snaps: list[dict]) -> dict:
     return out
 
 
-#: wire counters worth a dashboard line, rendered in this order
+#: wire counters worth a dashboard line, rendered in this order (the
+#: fedbuff async server adds server_version + the per-version lag max)
 _WIRE_KEYS = ("retransmits", "gave_up", "dup_dropped", "stale_uploads",
-              "uploads", "workers_alive")
+              "uploads", "workers_alive", "server_version",
+              "version_lag_max")
 
 
 def render(snaps: list[dict], path: str, stalled_s: float = 0.0) -> str:
