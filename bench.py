@@ -605,6 +605,119 @@ def _bench_fedbuff(tiny: bool):
     }
 
 
+def _bench_gateway(tiny: bool):
+    """fedgate (ISSUE 16): multi-tenant gateway scaling + noisy neighbor.
+
+    One in-process gateway (distributed/gateway.py, local transport)
+    multiplexing N concurrent federations over one shared listener, at
+    N = 1/4/8 tenants (tiny: 1/2). At every multi-tenant point the FIRST
+    tenant is a noisy neighbor — 30% seeded drop chaos — whose retransmit
+    storm hits the same shared listener as everyone else; the lanes are
+    capped (``wire_inbox_cap``) so flow control actually engages.
+
+    Per point: aggregate and per-tenant rounds/s, the flow-control counts
+    (WIRE_BUSY push-backs + stale sheds — the load the cap absorbed,
+    never silently), and the p99 upload latency a HEALTHY tenant's pulse
+    sketch recorded while the neighbor misbehaved — the isolation
+    headline: how much tail latency one tenant's chaos costs another."""
+    import shutil
+    import tempfile
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+    from fedml_tpu.distributed.gateway import run_gateway
+
+    workers = 2 if tiny else int(os.environ.get("BENCH_GATEWAY_WORKERS",
+                                                "13"))
+    tenant_points = (1, 2) if tiny else (1, 4, 8)
+    rounds = 2
+    cap = max(2, workers // 2)
+    cohort = workers * 2
+    dim = 16 if tiny else 64
+    ds = make_synthetic_classification(
+        "gateway-bench", (dim,), 5, cohort, records_per_client=16,
+        partition_method="hetero", partition_alpha=0.5, batch_size=8,
+        seed=0)
+
+    def cfg(**kw):
+        base = dict(
+            model="lr", dataset="gateway-bench", client_num_in_total=cohort,
+            client_num_per_round=cohort, comm_round=rounds, batch_size=8,
+            epochs=1, lr=0.1, seed=0, frequency_of_the_test=10_000,
+            device_data="off", wire_reliable=True, wire_inbox_cap=cap,
+            wire_retry_base_s=0.02, wire_retry_max=8)
+        base.update(kw)
+        return FedConfig(**base)
+
+    # absorb the jitted local-train compile OUTSIDE the timed points
+    run_fedavg_edge(ds, cfg(comm_round=1, wire_inbox_cap=0),
+                    worker_num=workers)
+
+    def _last_snap(path):
+        last = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        s = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(s, dict) and "round" in s:
+                        last = s
+        except OSError:
+            pass
+        return last
+
+    def point(n_tenants):
+        pulse_dir = tempfile.mkdtemp(prefix="bench-gw-")
+        tenants = []
+        for i in range(n_tenants):
+            kw = {}
+            if n_tenants > 1 and i == 0:
+                # the noisy neighbor: 30% drop on tenant 0's wire
+                kw = dict(chaos_drop=0.3, chaos_dup=0.1, chaos_seed=11)
+            tenants.append((f"t{i}", ds, cfg(**kw), workers))
+        t0 = time.perf_counter()
+        res = run_gateway(tenants, transport="local", timeout=600.0,
+                          pulse_dir=pulse_dir, max_tenants=n_tenants)
+        dt = time.perf_counter() - t0
+        healthy = res[f"t{n_tenants - 1}"]   # never the noisy one
+        sk = (_last_snap(healthy["pulse_path"]).get("sketches") or {})
+        busy = sum(r["wire"].get("gw_busy_sent", 0) for r in res.values())
+        shed = sum(r["wire"].get("gw_shed_stale", 0) for r in res.values())
+        row = {
+            "tenants": n_tenants,
+            "workers": n_tenants * workers,
+            "wall_s": round(dt, 3),
+            "rounds_per_sec_per_tenant": round(rounds / dt, 3),
+            "rounds_per_sec_total": round(n_tenants * rounds / dt, 3),
+            "busy_sent": busy,
+            "shed_stale": shed,
+            "healthy_upload_p99_ms": (sk.get("upload_ms") or {}).get("p99"),
+            "errors": [f"{t}: {r['error']}" for t, r in res.items()
+                       if r["error"]],
+        }
+        shutil.rmtree(pulse_dir, ignore_errors=True)
+        return row
+
+    points = [point(n) for n in tenant_points]
+    top = points[-1]
+    return {
+        "workers_per_tenant": workers,
+        "rounds": rounds,
+        "inbox_cap": cap,
+        "noisy_chaos_drop": 0.3,
+        "scale": points,
+        "tenants": top["tenants"],
+        "rounds_per_sec_per_tenant": top["rounds_per_sec_per_tenant"],
+        "rounds_per_sec_total": top["rounds_per_sec_total"],
+        "busy_sent": top["busy_sent"],
+        "shed_stale": top["shed_stale"],
+        "healthy_upload_p99_ms": top["healthy_upload_p99_ms"],
+    }
+
+
 def _bench_crossdevice(tiny: bool):
     """The cross-device block since ISSUE 13: headline numbers come from
     the fedsched scheduled+streamed path at million-client scale (the
@@ -621,6 +734,12 @@ def _bench_crossdevice(tiny: bool):
     fedbuff = None
     if not os.environ.get("BENCH_NO_FEDBUFF"):
         fedbuff = _bench_fedbuff(tiny)
+    # fedgate (ISSUE 16) runs after fedbuff, same caveat: its warm run is
+    # an edge launcher whose configure_from tears down the bench pulse
+    # plane (run_gateway itself streams to its own per-tenant planes)
+    gateway = None
+    if not os.environ.get("BENCH_NO_GATEWAY"):
+        gateway = _bench_gateway(tiny)
     head = sched["arms"][-1]      # streamed_speed
     return {
         "paradigm": "cross-device scheduled streaming rounds (fedsched: "
@@ -635,6 +754,7 @@ def _bench_crossdevice(tiny: bool):
         "device_resident": False,
         "fedsched": sched,
         "fedbuff": fedbuff,
+        "gateway": gateway,
         "r05_basis": basis,
         "uplift_vs_r05_basis": (
             round(head["clients_per_sec"] / basis["clients_per_sec"], 2)

@@ -1,0 +1,239 @@
+"""Gateway flow layers: bounded lane inboxes + tenant channel/link.
+
+The three bare transports are single-federation by construction: one
+process, one rank space, one unbounded inbox per rank. A multi-tenant
+federation gateway (distributed/gateway.py) multiplexes N federations over
+ONE shared transport listener, which needs exactly three mechanisms — all
+transport-agnostic, so they live here rather than in any backend:
+
+- :class:`BoundedInbox` — the per-tenant lane queue the gateway routes
+  into. Bounded (``--wire_inbox_cap``) with explicit overflow handling:
+  the mux either sheds a strictly-older queued upload or answers the
+  sender with WIRE_BUSY — never a silent drop, never unbounded growth.
+  Control items (the lane's shutdown sentinel, local injections, wire
+  acks) bypass the cap so backpressure can't wedge teardown or ack flow.
+- :class:`TenantChannel` — the WORKER-side shim between the wire
+  middleware stack and the bare transport: stamps every outgoing envelope
+  with the tenant id and the worker's global transport rank (the reply
+  address for gateway push-back), so even layer-generated traffic the
+  managers never see (reliable acks) arrives at the gateway routable.
+- :class:`TenantLink` — the GATEWAY-side lane transport: a
+  BaseCommunicationManager whose receive loop drains the lane's
+  BoundedInbox and whose send path translates tenant-LOCAL receiver ranks
+  to the shared transport's global rank space. Everything above it — the
+  lane's reliable layer, the unmodified FedAvg server manager — runs in
+  tenant-local rank space (rank 0 + workers 1..W), exactly as standalone;
+  the translation is one shallow envelope copy per send (the reliable
+  layer retransmits the SAME Message object, so in-place rewrites would
+  double-translate).
+
+Rank spaces: the shared transport has global ranks 0 (gateway) and
+``base_rank + r`` for tenant-local worker rank ``r`` (1..W), where
+``base_rank`` is the tenant's cumulative worker offset. Worker→gateway
+traffic needs NO translation (local receiver 0 == global 0, and the lane
+needs the LOCAL sender — the server computes the worker index from it);
+only gateway→worker sends translate, in :meth:`TenantLink.send_message`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_RECEIVER,
+    MSG_ARG_KEY_TENANT,
+    MSG_ARG_KEY_WIRE_MID,
+    Message,
+)
+
+#: the sender's GLOBAL transport rank, stamped by TenantChannel on every
+#: outgoing envelope — the gateway's reply address for WIRE_BUSY push-back
+#: and eviction NACKs (the envelope's ``sender`` stays tenant-local; the
+#: lane's server manager derives the worker index from it)
+MSG_ARG_KEY_GW_SRC = "__gw_src__"
+
+#: lane shutdown sentinel (same pattern as the bare transports' _STOP)
+STOP = object()
+
+
+class BoundedInbox:
+    """Bounded FIFO lane queue with mid-tracking and stale-shed support.
+
+    ``cap`` <= 0 means unbounded. ``try_put`` refuses when full (the mux
+    then sheds or replies busy); ``put_control`` always succeeds (shutdown
+    sentinel, local injections, acks). ``peak`` records the high-water
+    depth — the backpressure pin asserts ``peak <= cap``.
+    """
+
+    def __init__(self, cap: int = 0):
+        self.cap = int(cap)
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        # wire mids currently queued: the mux drops a retransmitted copy of
+        # a still-queued message instead of double-enqueueing it (the queued
+        # copy is unacked, so the sender keeps retrying until the lane
+        # processes and acks it — at-least-once is preserved)
+        self._mids: set = set()
+        self.peak = 0
+
+    def _append(self, item) -> None:
+        self._q.append(item)
+        if isinstance(item, Message):
+            mid = item.get(MSG_ARG_KEY_WIRE_MID)
+            if mid is not None:
+                self._mids.add(mid)
+        if len(self._q) > self.peak:
+            self.peak = len(self._q)
+        self._cv.notify()
+
+    def try_put(self, msg: Message) -> bool:
+        with self._cv:
+            if self.cap > 0 and len(self._q) >= self.cap:
+                return False
+            self._append(msg)
+            return True
+
+    def put_control(self, item) -> None:
+        with self._cv:
+            self._append(item)
+
+    def take(self):
+        with self._cv:
+            while not self._q:
+                self._cv.wait()
+            item = self._q.popleft()
+            if isinstance(item, Message):
+                self._mids.discard(item.get(MSG_ARG_KEY_WIRE_MID))
+            return item
+
+    def has_mid(self, mid) -> bool:
+        with self._cv:
+            return mid in self._mids
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def shed_older_than(self, round_tag: int) -> Optional[Message]:
+        """Evict and return the queued message with the SMALLEST round tag,
+        provided it is strictly older than ``round_tag`` (the incoming
+        message's round) — the load-shed policy: a stale upload of an
+        already-superseded round yields its slot to current-round traffic.
+        Returns None when nothing qualifies (the mux then answers the
+        incoming sender with WIRE_BUSY instead). The evicted message was
+        never acked, so its sender's reliable layer still owns it."""
+        with self._cv:
+            best_i = best_rnd = None
+            for i, item in enumerate(self._q):
+                if not isinstance(item, Message):
+                    continue
+                rnd = item.get("round_idx")
+                if rnd is None:
+                    continue
+                if best_rnd is None or int(rnd) < best_rnd:
+                    best_i, best_rnd = i, int(rnd)
+            if best_rnd is None or best_rnd >= int(round_tag):
+                return None
+            victim = self._q[best_i]
+            del self._q[best_i]
+            self._mids.discard(victim.get(MSG_ARG_KEY_WIRE_MID))
+            return victim
+
+    def drain(self) -> list:
+        """Empty the queue (quarantine teardown); returns the drained
+        Messages (sentinels excluded) so the caller can count them."""
+        with self._cv:
+            items = [m for m in self._q if isinstance(m, Message)]
+            self._q.clear()
+            self._mids.clear()
+            self._cv.notify_all()
+            return items
+
+
+class TenantChannel(BaseCommunicationManager, Observer):
+    """Worker-side shim under the wire middleware stack: stamps tenant id
+    + global source rank on every OUTGOING envelope (idempotent — the same
+    values land on a retransmit of the same Message object) and passes
+    inbound traffic through untouched (nothing on the worker's inbound
+    path reads the receiver field). Sits INSIDE chaos/reliable, so those
+    layers see the same tenant-local ids they would standalone."""
+
+    def __init__(self, inner: BaseCommunicationManager, tenant: str,
+                 global_rank: int):
+        super().__init__(codec=inner.codec)
+        self.inner = inner
+        self.tenant = str(tenant)
+        self.global_rank = int(global_rank)
+        inner.add_observer(self)
+
+    def send_message(self, msg: Message) -> None:
+        if MSG_ARG_KEY_TENANT not in msg:
+            msg.add_params(MSG_ARG_KEY_TENANT, self.tenant)
+        if MSG_ARG_KEY_GW_SRC not in msg:
+            msg.add_params(MSG_ARG_KEY_GW_SRC, self.global_rank)
+        self.inner.send_message(msg)
+
+    def receive_message(self, msg_type, msg: Message) -> None:
+        self._notify(msg)
+
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        self.inner.stop_receive_message()
+
+    def inject_local(self, msg: Message) -> None:
+        self.inner.inject_local(msg)
+
+    def supports_local_injection(self) -> bool:
+        return self.inner.supports_local_injection()
+
+
+class TenantLink(BaseCommunicationManager):
+    """Gateway-side lane transport: receive = drain the lane's
+    BoundedInbox (the mux fills it); send = translate the tenant-local
+    receiver rank to the shared transport's global rank space and forward.
+    The lane's reliable layer and the unmodified server manager stack on
+    top of this exactly as they would on a bare transport."""
+
+    def __init__(self, transport: BaseCommunicationManager,
+                 inbox: BoundedInbox, tenant: str, base_rank: int):
+        super().__init__(codec=transport.codec)
+        self.transport = transport
+        self.inbox = inbox
+        self.tenant = str(tenant)
+        self.base_rank = int(base_rank)
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        # shallow copy: the reliable layer retransmits the same Message
+        # object, so an in-place receiver rewrite would translate twice.
+        # Payload values are shared by reference — no pytree copy.
+        out = Message()
+        out.msg_params = dict(msg.msg_params)
+        out.codec = msg.codec
+        r = int(msg.get_receiver_id())
+        if r >= 1:
+            out.msg_params[MSG_ARG_KEY_RECEIVER] = self.base_rank + r
+        out.msg_params.setdefault(MSG_ARG_KEY_TENANT, self.tenant)
+        self.transport.send_message(out)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            item = self.inbox.take()
+            if item is STOP:
+                break
+            self._notify(item)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.inbox.put_control(STOP)
+
+    def inject_local(self, msg: Message) -> None:
+        # control injections (the straggler-deadline timer) must serialize
+        # with real traffic but never bounce off the cap
+        self.inbox.put_control(msg)

@@ -67,6 +67,7 @@ class GRPCCommManager(BaseCommunicationManager):
         host: str = "0.0.0.0",
         codec: str = "raw",
         send_timeout: float = 120.0,
+        inbox_cap: int = 0,
     ):
         super().__init__(codec=codec)
         self.rank = int(rank)
@@ -79,7 +80,11 @@ class GRPCCommManager(BaseCommunicationManager):
         if ip_table is None:
             ip_table = build_ip_table(ip_config_path) if ip_config_path else {r: "127.0.0.1" for r in range(size)}
         self.ip_table = ip_table
-        self._inbox: "queue.Queue" = queue.Queue()
+        # inbox_cap > 0 bounds the inbox (--wire_inbox_cap): a full inbox
+        # blocks the servicer thread, which parks the SENDER's unary call —
+        # gRPC's own flow control becomes the backpressure path. 0 keeps
+        # the historical unbounded queue.
+        self._inbox: "queue.Queue" = queue.Queue(maxsize=int(inbox_cap))
         self._channels: Dict[int, grpc.Channel] = {}
         self._stubs: Dict[int, grpc.UnaryUnaryMultiCallable] = {}
         self._lock = threading.Lock()
@@ -155,7 +160,18 @@ class GRPCCommManager(BaseCommunicationManager):
 
     def stop_receive_message(self) -> None:
         self._running = False
-        self._inbox.put(_STOP)
+        # teardown must not deadlock on a full bounded inbox: make room by
+        # dropping the oldest queued item (unacked under the reliable layer,
+        # so it is retransmitted — and the loop is exiting regardless)
+        while True:
+            try:
+                self._inbox.put(_STOP, timeout=0.05)
+                return
+            except queue.Full:
+                try:
+                    self._inbox.get_nowait()
+                except queue.Empty:
+                    pass
 
     def _shutdown(self) -> None:
         with self._lock:

@@ -28,14 +28,40 @@ _STOP = object()
 
 
 class LocalRouter:
-    """Shared mailbox set for a group of ranks (one per launch)."""
+    """Shared mailbox set for a group of ranks (one per launch).
 
-    def __init__(self, size: int):
+    ``cap`` bounds every mailbox (0 = unbounded, the historical default):
+    a sender to a full mailbox BLOCKS until the receiver drains — the
+    in-process analogue of TCP flow control, and what ``--wire_inbox_cap``
+    means on this transport. The gateway's per-tenant lanes add the
+    WIRE_BUSY reply protocol on top (comm/flow.py); the transport itself
+    only ever holds, never drops.
+    """
+
+    def __init__(self, size: int, cap: int = 0):
         self.size = size
-        self._queues: Dict[int, "queue.Queue"] = {r: queue.Queue() for r in range(size)}
+        self.cap = int(cap)
+        self._queues: Dict[int, "queue.Queue"] = {
+            r: queue.Queue(maxsize=self.cap) for r in range(size)}
 
     def post(self, rank: int, item) -> None:
         self._queues[int(rank)].put(item)
+
+    def post_control(self, rank: int, item) -> None:
+        """Teardown-priority post: never blocks forever on a full mailbox —
+        drops the oldest queued item to make room (the receiver is being
+        stopped; under the reliable layer an unacked drop is retransmitted,
+        and at teardown the peer's retries are bounded anyway)."""
+        q = self._queues[int(rank)]
+        while True:
+            try:
+                q.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
 
     def take(self, rank: int, timeout: Optional[float] = None):
         return self._queues[int(rank)].get(timeout=timeout)
@@ -72,12 +98,12 @@ class LocalCommunicationManager(BaseCommunicationManager):
 
     def stop_receive_message(self) -> None:
         self._running = False
-        self.router.post(self.rank, _STOP)
+        self.router.post_control(self.rank, _STOP)
 
 
 def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
               timeout: float = 300.0, comm_factory=None, codec: str = "raw",
-              wrap=None):
+              wrap=None, inbox_cap: int = 0):
     """Launch ``size`` ranks on threads; rank r runs make_manager(r, comm).
 
     ``make_manager`` returns an object with ``.run()`` (typically a
@@ -93,8 +119,11 @@ def run_ranks(make_manager, size: int, wire_roundtrip: bool = False,
     ``wrap(rank, comm) -> comm`` layers wire middleware (reliable delivery,
     chaos injection — comm/reliable.py wire_wrap_factory) over whichever
     transport was built, so every protocol gets it without code changes.
+    ``inbox_cap`` bounds the default router's per-rank mailboxes
+    (``--wire_inbox_cap``; 0 = unbounded); a comm_factory configures its
+    own backend's cap.
     """
-    router = None if comm_factory else LocalRouter(size)
+    router = None if comm_factory else LocalRouter(size, cap=inbox_cap)
     comms: list[BaseCommunicationManager] = []
     try:
         for r in range(size):

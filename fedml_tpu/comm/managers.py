@@ -18,13 +18,24 @@ import logging
 from typing import Callable, Dict
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
-from fedml_tpu.comm.message import MSG_ARG_KEY_TRACE_CTX, Message
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_TENANT,
+    MSG_ARG_KEY_TRACE_CTX,
+    Message,
+)
 from fedml_tpu.obs import tracer_if_enabled
 
 LOG = logging.getLogger(__name__)
 
 
 class _ManagerBase(Observer):
+    #: tenant id under a federation gateway (distributed/gateway.py): when
+    #: set, every outgoing envelope is stamped with ``__tenant__`` so the
+    #: gateway can route it into this tenant's lane — exactly the trace-ctx
+    #: pattern below. None (the default) stamps nothing: a standalone
+    #: federation's wire bytes are unchanged.
+    tenant: "str | None" = None
+
     def __init__(self, args, comm: BaseCommunicationManager, rank: int = 0, size: int = 0):
         self.args = args
         self.com_manager = comm
@@ -70,6 +81,8 @@ class _ManagerBase(Observer):
             handler(msg_params)
 
     def send_message(self, message: Message) -> None:
+        if self.tenant is not None:
+            message.add_params(MSG_ARG_KEY_TENANT, self.tenant)
         tr = tracer_if_enabled(self.rank)
         if tr is None:
             self.com_manager.send_message(message)

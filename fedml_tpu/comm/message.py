@@ -37,6 +37,21 @@ MSG_ARG_KEY_WIRE_INC = "__wire_inc__"
 # dispatch, deliberately outside the handler registry — registering one
 # would deliver acks to application code.
 MSG_TYPE_WIRE_ACK = "__wire_ack__"  # fedlint: disable=protocol-exhaustiveness
+# Gateway backpressure signal (comm/flow.py, distributed/gateway.py): the
+# gateway answers a send that found a tenant lane over its high-water mark
+# with WIRE_BUSY carrying the message id and a retry-after derived from the
+# retry schedule. Consumed inline by the reliable layer (it re-arms the
+# pending send's clock without burning retry attempts — busy is not dead);
+# with ``terminal`` set it is an eviction/NACK: the sender abandons its
+# outstanding sends to that peer and tears down. Never dispatched to
+# handlers, same rationale as the ACK above.
+MSG_TYPE_WIRE_BUSY = "__wire_busy__"  # fedlint: disable=protocol-exhaustiveness
+# Tenant id (distributed/gateway.py): stamped by _ManagerBase.send_message
+# when the manager carries a ``tenant`` attribute (like the trace context
+# below), and by the gateway flow layer on layer-generated control traffic
+# (acks). The gateway routes by (tenant, rank) into per-tenant lanes;
+# handlers never read it, and a tenant-less federation never stamps it.
+MSG_ARG_KEY_TENANT = "__tenant__"
 # Trace context (fedml_tpu/obs, DESIGN.md §12): (trace id, parent span id,
 # message uid), stamped by the traced send in comm/managers.py and read
 # back at dispatch so a recv span links to the send span that caused it —

@@ -36,12 +36,15 @@ _STOP = object()
 
 class MqttCommManager(BaseCommunicationManager):
     def __init__(self, host: str, port: int, client_id: int, client_num: int,
-                 topic: str = "fedml", codec: str = "raw"):
+                 topic: str = "fedml", codec: str = "raw", inbox_cap: int = 0):
         super().__init__(codec=codec)
         self.client_id = int(client_id)
         self.client_num = int(client_num)
         self.topic = topic
-        self._inbox: "queue.Queue" = queue.Queue()
+        # inbox_cap > 0 bounds the inbox (--wire_inbox_cap): a full inbox
+        # blocks the broker network loop, so TCP flow control throttles the
+        # broker -> this node stream. 0 keeps the historical unbounded queue.
+        self._inbox: "queue.Queue" = queue.Queue(maxsize=int(inbox_cap))
         self._running = False
         self._client = _mqtt.Client(client_id=f"{topic}_node{client_id}", protocol=_mqtt.MQTTv311)
         self._client.on_connect = self._on_connect
@@ -89,4 +92,15 @@ class MqttCommManager(BaseCommunicationManager):
 
     def stop_receive_message(self) -> None:
         self._running = False
-        self._inbox.put(_STOP)
+        # teardown must not deadlock on a full bounded inbox: drop the
+        # oldest queued item to make room (the loop is exiting anyway; an
+        # unacked drop under the reliable layer is retransmitted)
+        while True:
+            try:
+                self._inbox.put(_STOP, timeout=0.05)
+                return
+            except queue.Full:
+                try:
+                    self._inbox.get_nowait()
+                except queue.Empty:
+                    pass

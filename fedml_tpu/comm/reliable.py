@@ -50,6 +50,7 @@ from fedml_tpu.comm.message import (
     MSG_ARG_KEY_WIRE_MID,
     MSG_ARG_KEY_WIRE_SEQ,
     MSG_TYPE_WIRE_ACK,
+    MSG_TYPE_WIRE_BUSY,
     Message,
 )
 
@@ -57,10 +58,25 @@ LOG = logging.getLogger(__name__)
 
 KEY_ACK_MID = "ack_mid"
 KEY_ACK_SEQ = "ack_seq"
+# WIRE_BUSY payload (distributed/gateway.py produces, this layer consumes):
+# the message id being pushed back, the seconds the sender should hold off
+# before the next attempt, and — for admission NACKs / tenant eviction —
+# a terminal flag plus a human-readable reason.
+KEY_BUSY_MID = "busy_mid"
+KEY_BUSY_RETRY_S = "retry_after_s"
+KEY_BUSY_TERMINAL = "terminal"
+KEY_BUSY_REASON = "reason"
+
+#: busy re-arms allowed per pending message before WIRE_BUSY stops
+#: resetting its retry clock: a receiver that answers busy forever must
+#: eventually look dead (gave_up fires, the death oracle runs) instead of
+#: holding the sender in a live-lock.
+MAX_BUSY_REARMS_PER_RETRY = 4
 
 
 class _Pending:
-    __slots__ = ("msg", "receiver", "attempts", "next_due", "in_flight")
+    __slots__ = ("msg", "receiver", "attempts", "next_due", "in_flight",
+                 "busy_rearms")
 
     def __init__(self, msg: Message, receiver: int, next_due: float):
         self.msg = msg
@@ -68,6 +84,7 @@ class _Pending:
         self.attempts = 0          # retransmit attempts (first send excluded)
         self.next_due = next_due
         self.in_flight = False     # a retransmit send is currently executing
+        self.busy_rearms = 0       # WIRE_BUSY retry-clock resets consumed
 
 
 class ReliableCommManager(BaseCommunicationManager, Observer):
@@ -84,6 +101,11 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
         # drain must outlive the retries it exists to host
         drain_timeout_s: float = 8.0,
         dedup_window: int = 4096,
+        # idle-pair GC horizon: a (sender, incarnation) dedup window idle
+        # this long is dropped (None derives ~8x the retry budget — past
+        # it no bounded-retry duplicate can still arrive). Bounds state in
+        # a long-lived server hosting many short peer lifetimes.
+        idle_gc_s: Optional[float] = None,
     ):
         super().__init__(codec=inner.codec)
         self.inner = inner
@@ -99,11 +121,22 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
         # rank restarts its seq stream, so each incarnation deduplicates
         # independently instead of colliding with its predecessor's window
         self._seen: Dict[tuple, set] = {}
+        # last-activity clock per dedup pair, for the idle GC sweep
+        self._seen_touch: Dict[tuple, float] = {}
+        budget = sum(self._backoff_of(retry_base_s, retry_cap_s, i)
+                     for i in range(self.retry_max + 1))
+        self.idle_gc_s = (float(idle_gc_s) if idle_gc_s is not None
+                          else max(30.0, 8.0 * budget))
+        self._next_gc = time.monotonic() + self.idle_gc_s
         self._inc = uuid.uuid4().hex[:12]
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._stopping = False
         self._closed = False
+        # receivers that exhausted a message's full retry budget at least
+        # once and have not acked since — peer_dead counts each transition
+        # into this set (once per death, not per abandoned message)
+        self._dead_peers: set = set()
         # counters are a CounterGroup view over the unified registry
         # (fedml_tpu/obs): same dict-style access and key names as before,
         # but registry.snapshot("wire") now sees every live layer at once
@@ -113,6 +146,7 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
             "sent", "retransmits", "retransmit_errors",
             "gave_up", "acked", "acks_sent",
             "delivered", "dup_dropped",
+            "peer_dead", "busy_backoff", "evicted",
         ))
         #: optional ``(receiver_rank, msg) -> None`` hook invoked (off the
         #: registry lock) when a message to that peer exhausts its retries —
@@ -165,8 +199,12 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                 pend.next_due = time.monotonic() + self._backoff(0)
             self._cv.notify()
 
+    @staticmethod
+    def _backoff_of(base: float, cap: float, attempt: int) -> float:
+        return min(float(base) * (2 ** attempt), float(cap))
+
     def _backoff(self, attempt: int) -> float:
-        return min(self.retry_base_s * (2 ** attempt), self.retry_cap_s)
+        return self._backoff_of(self.retry_base_s, self.retry_cap_s, attempt)
 
     def _retransmit_loop(self) -> None:
         while True:
@@ -188,6 +226,13 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                     if p.attempts > self.retry_max:
                         self._outstanding.pop(mid)
                         self.stats["gave_up"] += 1
+                        if p.receiver not in self._dead_peers:
+                            # one death per peer (cleared by a later ack):
+                            # the watchdog's peer_dead delta rule and every
+                            # edge paradigm's pulse stream see dead workers
+                            # without wiring a per-protocol hook
+                            self._dead_peers.add(p.receiver)
+                            self.stats["peer_dead"] += 1
                         gave_up.append(p)
                         self._cv.notify_all()
                         LOG.warning(
@@ -198,6 +243,9 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                     p.next_due = now + self._backoff(p.attempts)
                     p.in_flight = True
                     due.append(p)
+                if now >= self._next_gc:
+                    self._gc_idle_pairs(now)
+                    self._next_gc = now + max(0.05, self.idle_gc_s / 4.0)
                 if not due and not gave_up:
                     self._cv.wait(timeout=wait)
                     continue
@@ -252,9 +300,17 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
     def receive_message(self, msg_type, msg: Message) -> None:
         if msg_type == MSG_TYPE_WIRE_ACK:
             with self._cv:
-                if self._outstanding.pop(msg.get(KEY_ACK_MID), None) is not None:
+                p = self._outstanding.pop(msg.get(KEY_ACK_MID), None)
+                if p is not None:
                     self.stats["acked"] += 1
+                    # an ack is proof of life: a peer that died (retry
+                    # exhaustion) and came back counts as a NEW death next
+                    # time instead of being forever-dead
+                    self._dead_peers.discard(p.receiver)
                     self._cv.notify_all()
+            return
+        if msg_type == MSG_TYPE_WIRE_BUSY:
+            self._handle_busy(msg)
             return
         seq = msg.get(MSG_ARG_KEY_WIRE_SEQ)
         if seq is None:
@@ -290,7 +346,39 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
         self.stats["delivered"] += 1
         self._notify(msg)
 
+    def _handle_busy(self, msg: Message) -> None:
+        """Gateway push-back consumer. Non-terminal WIRE_BUSY re-arms the
+        pending message's retry clock at the receiver-suggested delay
+        WITHOUT burning a retry (busy != dead) — bounded by
+        MAX_BUSY_REARMS_PER_RETRY so a forever-busy receiver eventually
+        falls through to normal retry exhaustion and the dead-peer oracle.
+        Terminal WIRE_BUSY (admission NACK / tenant eviction) abandons all
+        outstanding sends and stops the layer: the federation this worker
+        belongs to no longer exists at the gateway."""
+        if msg.get(KEY_BUSY_TERMINAL):
+            with self._cv:
+                if self._outstanding:
+                    self._outstanding.clear()
+                self.stats["evicted"] += 1
+                self._cv.notify_all()
+            LOG.warning("rank %d: evicted by receiver (%s)", self.rank,
+                        msg.get(KEY_BUSY_REASON) or "no reason given")
+            self.stop_receive_message()
+            return
+        retry_after = float(msg.get(KEY_BUSY_RETRY_S) or
+                            self.retry_base_s * 4.0)
+        with self._cv:
+            p = self._outstanding.get(msg.get(KEY_BUSY_MID))
+            if (p is not None and p.busy_rearms
+                    < self.retry_max * MAX_BUSY_REARMS_PER_RETRY):
+                p.busy_rearms += 1
+                p.attempts = 0
+                p.next_due = time.monotonic() + retry_after
+                self.stats["busy_backoff"] += 1
+                self._cv.notify_all()
+
     def _is_dup_and_mark(self, sender: tuple, seq: int) -> bool:
+        self._seen_touch[sender] = time.monotonic()
         seen = self._seen.setdefault(sender, set())
         if seq in seen:
             return True
@@ -301,6 +389,19 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
             cutoff = max(seen) - self.dedup_window
             self._seen[sender] = {s for s in seen if s >= cutoff}
         return False
+
+    def _gc_idle_pairs(self, now: float) -> None:
+        """Drop dedup windows for (sender, incarnation) pairs idle past the
+        GC horizon (runs under the lock, from the retransmit loop). Safe
+        because retries are bounded: past ~the retry budget no duplicate of
+        an already-seen message can still arrive, so forgetting the window
+        cannot re-admit one. A long-lived gateway lane hosting thousands of
+        short worker lifetimes keeps O(live peers) state, not O(ever-seen
+        incarnations)."""
+        cutoff = now - self.idle_gc_s
+        for pair in [p for p, t in self._seen_touch.items() if t < cutoff]:
+            self._seen.pop(pair, None)
+            self._seen_touch.pop(pair, None)
 
     # -- lifecycle ---------------------------------------------------------
     def handle_receive_message(self) -> None:

@@ -114,6 +114,20 @@ class FedConfig:
     # schedule automatically.
     wire_retry_base_s: float = 0.05
     wire_retry_max: int = 10
+    # Bounded inboxes (comm/local.py, grpc_backend.py, mqtt_backend.py) and
+    # the gateway's per-tenant lane queues (comm/flow.py): 0 keeps the
+    # historical unbounded queues; > 0 caps delivery-queue depth. On bare
+    # transports a full inbox BLOCKS the producer (queue put / gRPC flow
+    # control / broker TCP); at the gateway a full lane answers WIRE_BUSY,
+    # so the cap requires wire_reliable=True there (the sender's reliable
+    # layer consumes the push-back).
+    wire_inbox_cap: int = 0
+    # Federation gateway quotas (distributed/gateway.py): over-admission is
+    # rejected with a typed terminal NACK, never silently. max_tenants caps
+    # concurrent federations; tenant_workers (0 = unlimited) caps any one
+    # tenant's worker count.
+    gateway_max_tenants: int = 8
+    gateway_tenant_workers: int = 0
     # Chaos injection (comm/chaos.py): seeded, deterministic wire faults for
     # robustness testing. Rates are per-transmission probabilities; delay is
     # the max per-message latency in ms (uniform draw). chaos_crash_rank /
@@ -415,6 +429,18 @@ class FedConfig:
         if self.wire_retry_max < 1:
             raise ValueError(
                 f"wire_retry_max must be >= 1, got {self.wire_retry_max}")
+        if self.wire_inbox_cap < 0:
+            raise ValueError(
+                f"wire_inbox_cap must be >= 0 (0 = unbounded), got "
+                f"{self.wire_inbox_cap}")
+        if self.gateway_max_tenants < 1:
+            raise ValueError(
+                f"gateway_max_tenants must be >= 1, got "
+                f"{self.gateway_max_tenants}")
+        if self.gateway_tenant_workers < 0:
+            raise ValueError(
+                f"gateway_tenant_workers must be >= 0 (0 = unlimited), got "
+                f"{self.gateway_tenant_workers}")
         if self.buffer_k < 1:
             raise ValueError(
                 f"buffer_k must be >= 1, got {self.buffer_k}: a version "
@@ -687,6 +713,18 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                    default=defaults.wire_retry_max,
                    help="retransmits before a message gives up (the "
                         "dead-peer detection budget)")
+    p.add_argument("--wire_inbox_cap", type=int,
+                   default=defaults.wire_inbox_cap,
+                   help="bounded inbox / gateway lane depth (0 = unbounded; "
+                        "gateway lanes answer WIRE_BUSY over the cap)")
+    p.add_argument("--gateway_max_tenants", type=int,
+                   default=defaults.gateway_max_tenants,
+                   help="concurrent federations one gateway admits (excess "
+                        "gets a typed NACK)")
+    p.add_argument("--gateway_tenant_workers", type=int,
+                   default=defaults.gateway_tenant_workers,
+                   help="per-tenant worker quota at the gateway (0 = "
+                        "unlimited)")
     p.add_argument("--chaos_seed", type=int, default=defaults.chaos_seed)
     p.add_argument("--chaos_drop", type=float, default=defaults.chaos_drop,
                    help="P(drop) per transmission (needs --wire_reliable 1)")

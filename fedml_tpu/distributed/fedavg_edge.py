@@ -942,7 +942,8 @@ def run_fedavg_edge(dataset, config, worker_num: int, wire_roundtrip: bool = Tru
     managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip,
                          comm_factory=comm_factory, timeout=timeout,
                          codec=getattr(config, "wire_codec", "raw"),
-                         wrap=wire_wrap_factory(config))
+                         wrap=wire_wrap_factory(config),
+                         inbox_cap=int(getattr(config, "wire_inbox_cap", 0) or 0))
     from fedml_tpu.utils.metrics import merge_wire_stats
 
     aggregator.wire_stats = merge_wire_stats(

@@ -732,7 +732,8 @@ def run_fedbuff_edge(dataset, config, worker_num: int,
     managers = run_ranks(make, size, wire_roundtrip=wire_roundtrip,
                          comm_factory=comm_factory, timeout=timeout,
                          codec=getattr(config, "wire_codec", "raw"),
-                         wrap=wire_wrap_factory(config))
+                         wrap=wire_wrap_factory(config),
+                         inbox_cap=int(getattr(config, "wire_inbox_cap", 0) or 0))
     # Release every rank's wire stack explicitly: a crash-stopped rank's
     # receive loop exits WITHOUT reaching finish(), and an un-stopped
     # reliable layer's retransmit thread is an immortal reference to its

@@ -1,0 +1,507 @@
+"""fedgate: multi-tenant federation gateway (DESIGN.md §19).
+
+One long-lived gateway process multiplexes N concurrent federations
+("tenants") over ONE shared transport listener — the deployment shape a
+fleet operator actually runs (one ingress, many model programs), where the
+tree previously assumed one process per federation. Three pillars:
+
+- **Tenant isolation.** Every envelope carries ``__tenant__`` (stamped by
+  ``_ManagerBase.send_message`` and backstopped by the worker-side
+  :class:`~fedml_tpu.comm.flow.TenantChannel` for layer-generated acks).
+  The :class:`GatewayMux` routes by tenant into per-tenant handler lanes,
+  each with its OWN reliable-layer state, its own
+  :class:`~fedml_tpu.obs.registry.MetricsRegistry` (every counter surface
+  the lane touches attaches there via ``registry_scope``), its own pulse
+  stream (``pulse-<tenant>.jsonl``) and its own delta-baselined watchdog.
+  A tenant whose watchdog escalates (NaN/divergent loss, gave-up storm,
+  version lag) is QUARANTINED: its lane drains, its workers get a terminal
+  eviction, its dedup windows and pending maps are released — while every
+  other tenant continues bit-identically to a standalone run (pinned by
+  tests/test_gateway.py).
+- **Backpressure.** Lane inboxes are bounded (``--wire_inbox_cap``). Over
+  the high-water mark the mux answers WIRE_BUSY with a retry-after derived
+  from the tenant's ``retry_budget_s``; the sender's reliable layer holds
+  the message and backs off without burning retries (busy is not dead).
+- **Load-shedding + admission.** ``--gateway_max_tenants`` /
+  ``--gateway_tenant_workers`` quotas reject over-admission with a typed
+  terminal NACK. When a lane is full, the shed policy evicts the queued
+  upload with the strictly-oldest round tag first — counted on the
+  tenant's wire lane, never silently (the evicted sender is busy-notified
+  and retransmits).
+
+The lanes run the UNMODIFIED edge protocol stack: tenant-local rank space
+(server 0, workers 1..W), the same ``build_edge_rank`` construction as
+``run_fedavg_edge`` — the gateway is pure routing + flow control, which is
+what makes the bit-identity pin possible.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.flow import (
+    MSG_ARG_KEY_GW_SRC,
+    BoundedInbox,
+    TenantChannel,
+    TenantLink,
+)
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_TENANT,
+    MSG_ARG_KEY_WIRE_MID,
+    MSG_TYPE_WIRE_ACK,
+    MSG_TYPE_WIRE_BUSY,
+    Message,
+)
+from fedml_tpu.comm.reliable import (
+    KEY_BUSY_MID,
+    KEY_BUSY_REASON,
+    KEY_BUSY_RETRY_S,
+    KEY_BUSY_TERMINAL,
+    ReliableCommManager,
+    build_wire_stack,
+    retry_budget_s,
+    retry_schedule,
+)
+from fedml_tpu.obs import (
+    HealthWatchdog,
+    LiveExporter,
+    PulsePlane,
+    FederationHealthError,
+    MetricsRegistry,
+    plane_scope,
+    registry_scope,
+)
+from fedml_tpu.obs.profile import ClientProfiler
+
+LOG = logging.getLogger(__name__)
+
+#: round tag key (fedavg_edge.MSG_ARG_KEY_ROUND; literal to keep comm-layer
+#: imports out of the shed path) — the shed policy orders uploads by it
+_KEY_ROUND = "round_idx"
+
+
+class TenantLane:
+    """One tenant's gateway-side state: registry, pulse plane, bounded
+    inbox, wire-lane counters, worker global ranks, quarantine flag."""
+
+    def __init__(self, tenant: str, config, worker_num: int, base_rank: int,
+                 inbox_cap: int, pulse_path: Optional[str]):
+        self.tenant = str(tenant)
+        self.config = config
+        self.worker_num = int(worker_num)
+        self.base_rank = int(base_rank)
+        self.quarantined = False
+        self.error: Optional[str] = None
+        self.registry = MetricsRegistry()
+        self.inbox = BoundedInbox(cap=inbox_cap)
+        # the mux's per-tenant counters live on THIS tenant's wire lane so
+        # its pulse snapshots and the cross-tenant leakage pin both see them
+        self.wire = self.registry.group("wire", rank=0, keys=(
+            "gw_enqueued", "gw_dup_suppressed", "gw_busy_sent",
+            "gw_shed_stale", "gw_drained", "gw_inbox_peak"))
+        # derived push-back delay: roughly the mean backoff of the tenant's
+        # retry schedule — long enough to let the lane drain, short enough
+        # that a held upload lands well inside the retry budget
+        _, _, retry_max = retry_schedule(config)
+        self.retry_after_s = retry_budget_s(config) / max(1, retry_max + 1)
+        self.pulse_path = pulse_path
+        exporter = LiveExporter(pulse_path) if pulse_path else None
+        profiler = ClientProfiler() if exporter is not None else None
+        # escalation is ALWAYS on at the gateway: a critical tenant is
+        # quarantined (lane-local), never allowed to take the process down —
+        # the per-run --health_escalate flag governs standalone runs only
+        watchdog = HealthWatchdog(
+            loss_limit=getattr(config, "health_loss_limit", 0.0),
+            stall_sec=getattr(config, "health_stall_sec", None),
+            stale_spike=getattr(config, "health_stale_spike", 8),
+            skew=getattr(config, "health_skew", 4.0),
+            version_lag=getattr(config, "health_version_lag", 0.0),
+            escalate=True)
+        watchdog.baseline(self.registry.snapshot("wire"))
+        self.plane = PulsePlane(exporter=exporter, profiler=profiler,
+                                watchdog=watchdog, registry=self.registry)
+        self.aggregator = None
+        self.comm: Optional[BaseCommunicationManager] = None
+
+    @property
+    def worker_global_ranks(self) -> List[int]:
+        return [self.base_rank + r for r in range(1, self.worker_num + 1)]
+
+
+class GatewayMux(Observer):
+    """Observer of the gateway's shared transport (global rank 0): routes
+    by tenant into lanes, answers over-cap traffic with WIRE_BUSY, sheds
+    stale uploads, NACKs unknown/rejected/quarantined tenants. Runs on the
+    single gateway receive thread; lane threads only ever TAKE from the
+    inboxes, so the routing path is lock-light."""
+
+    def __init__(self, transport: BaseCommunicationManager,
+                 registry: MetricsRegistry):
+        self.transport = transport
+        self.lanes: Dict[str, TenantLane] = {}
+        self.rejected: Dict[str, str] = {}
+        # gateway-level (cross-tenant) counters: admission rejections and
+        # untagged drops belong to the gateway, not to any tenant registry
+        self.stats = registry.group("gateway", rank=0, keys=(
+            "routed", "untagged_dropped", "nack_unknown", "nack_rejected",
+            "nack_quarantined", "no_reply_addr"))
+
+    # -- routing -----------------------------------------------------------
+    def receive_message(self, msg_type, msg: Message) -> None:
+        tenant = msg.get(MSG_ARG_KEY_TENANT)
+        if tenant is None:
+            # a tenant-less envelope cannot be routed; counted, never silent
+            self.stats["untagged_dropped"] += 1
+            LOG.warning("gateway: dropped untagged %r", msg_type)
+            return
+        lane = self.lanes.get(tenant)
+        if lane is None:
+            reason = self.rejected.get(tenant)
+            if reason is None:
+                self.stats["nack_unknown"] += 1
+                reason = f"unknown tenant {tenant!r}"
+            else:
+                self.stats["nack_rejected"] += 1
+            self._nack(msg, reason)
+            return
+        if lane.quarantined:
+            self.stats["nack_quarantined"] += 1
+            self._nack(msg, f"tenant {tenant!r} quarantined")
+            return
+        self.stats["routed"] += 1
+        if msg_type == MSG_TYPE_WIRE_ACK:
+            # acks must flow even through a full lane, or backpressure on
+            # uploads would also stall the ack stream that relieves it
+            lane.inbox.put_control(msg)
+            return
+        mid = msg.get(MSG_ARG_KEY_WIRE_MID)
+        if mid is not None and lane.inbox.has_mid(mid):
+            # retransmitted copy of a still-queued (unacked) message: the
+            # queued copy will be acked when the lane processes it
+            lane.wire["gw_dup_suppressed"] += 1
+            return
+        if lane.inbox.try_put(msg):
+            lane.wire["gw_enqueued"] += 1
+            lane.wire["gw_inbox_peak"] = max(
+                lane.wire["gw_inbox_peak"], lane.inbox.peak)
+            return
+        # lane over its high-water mark: shed a strictly-older queued
+        # upload in favour of current-round traffic, else push back
+        rnd = msg.get(_KEY_ROUND)
+        victim = (lane.inbox.shed_older_than(int(rnd))
+                  if rnd is not None else None)
+        if victim is not None:
+            lane.wire["gw_shed_stale"] += 1
+            self._busy(victim, lane)   # re-arm the evicted sender's clock
+            if not lane.inbox.try_put(msg):
+                lane.wire["gw_busy_sent"] += 1
+                self._busy(msg, lane)
+            else:
+                lane.wire["gw_enqueued"] += 1
+        else:
+            lane.wire["gw_busy_sent"] += 1
+            self._busy(msg, lane)
+
+    # -- push-back replies -------------------------------------------------
+    def _reply_rank(self, msg: Message) -> Optional[int]:
+        return msg.get(MSG_ARG_KEY_GW_SRC)
+
+    def _busy(self, msg: Message, lane: TenantLane) -> None:
+        src = self._reply_rank(msg)
+        if src is None:
+            self.stats["no_reply_addr"] += 1
+            return
+        out = Message(MSG_TYPE_WIRE_BUSY, 0, int(src))
+        out.add_params(KEY_BUSY_MID, msg.get(MSG_ARG_KEY_WIRE_MID))
+        out.add_params(KEY_BUSY_RETRY_S, lane.retry_after_s)
+        try:
+            self.transport.send_message(out)
+        except Exception as e:  # push-back is best-effort: retries cover it
+            LOG.debug("gateway: busy reply to %s failed (%s)", src, e)
+
+    def _nack(self, msg: Message, reason: str) -> None:
+        src = self._reply_rank(msg)
+        if src is None:
+            self.stats["no_reply_addr"] += 1
+            return
+        self._evict_rank(int(src), reason)
+
+    def _evict_rank(self, global_rank: int, reason: str) -> None:
+        out = Message(MSG_TYPE_WIRE_BUSY, 0, int(global_rank))
+        out.add_params(KEY_BUSY_TERMINAL, True)
+        out.add_params(KEY_BUSY_REASON, reason)
+        try:
+            self.transport.send_message(out)
+        except Exception as e:
+            LOG.debug("gateway: eviction to %d failed (%s)", global_rank, e)
+
+    # -- quarantine --------------------------------------------------------
+    def quarantine(self, tenant: str, reason: str) -> None:
+        """Fault-isolate one tenant: flag the lane (subsequent traffic is
+        NACKed), drain its inbox, send every worker a terminal eviction.
+        The lane thread calls this when its watchdog escalates; other
+        tenants' lanes are untouched by construction (own threads, own
+        queues, own registries)."""
+        lane = self.lanes.get(tenant)
+        if lane is None or lane.quarantined:
+            return
+        lane.quarantined = True
+        drained = lane.inbox.drain()
+        lane.wire["gw_drained"] += len(drained)
+        LOG.warning("gateway: quarantined tenant %r (%s); drained %d queued",
+                    tenant, reason, len(drained))
+        for g in lane.worker_global_ranks:
+            self._evict_rank(g, f"tenant {tenant!r} quarantined: {reason}")
+
+
+def _make_local_factory(size: int, wire_roundtrip: bool):
+    from fedml_tpu.comm.local import LocalCommunicationManager, LocalRouter
+
+    # the SHARED router is unbounded: backpressure is the lanes' protocol
+    # (BoundedInbox + WIRE_BUSY), and a capped rank-0 queue could stall the
+    # mux's own push-back replies behind the flood they answer
+    router = LocalRouter(size)
+
+    def make(global_rank: int) -> BaseCommunicationManager:
+        return LocalCommunicationManager(router, global_rank,
+                                         wire_roundtrip=wire_roundtrip)
+
+    return make
+
+
+def _make_grpc_factory(size: int, base_port: int):
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    def make(global_rank: int) -> BaseCommunicationManager:
+        return GRPCCommManager(rank=global_rank, size=size,
+                               base_port=base_port, host="127.0.0.1")
+
+    return make
+
+
+def run_gateway(tenants, transport: str = "local", timeout: float = 300.0,
+                pulse_dir: Optional[str] = None, inbox_cap: Optional[int] = None,
+                max_tenants: Optional[int] = None,
+                tenant_workers: Optional[int] = None,
+                grpc_base_port: int = 57200, wire_roundtrip: bool = True):
+    """Run N federations through one in-process gateway.
+
+    ``tenants`` is a list of ``(tenant_id, dataset, config, worker_num)``.
+    Each tenant runs the unmodified FedAvg edge protocol in tenant-local
+    rank space behind its own gateway lane; quotas
+    (``max_tenants``/``tenant_workers``, defaulting to the first tenant
+    config's ``gateway_max_tenants``/``gateway_tenant_workers``) reject
+    over-admission with a typed reason. Returns ``{tenant_id: result}``
+    where result carries ``admitted``/``reject_reason``/``quarantined``/
+    ``error``/``aggregator``/``wire`` (the tenant registry's wire
+    snapshot)/``pulse_path``/``plane``.
+
+    Per-tenant ``pulse_path`` configs are ignored here — the process-wide
+    pulse plane is a singleton; tenants stream to
+    ``<pulse_dir>/pulse-<tenant>.jsonl`` instead (fedtop's directory mode
+    tails them side by side).
+    """
+    from fedml_tpu.core.rng import seed_everything
+    from fedml_tpu.distributed.fedavg_edge import (
+        build_edge_rank,
+        make_aggregator,
+    )
+    from fedml_tpu.models import create_model
+
+    if not tenants:
+        raise ValueError("run_gateway needs at least one tenant")
+    first_cfg = tenants[0][2]
+    if max_tenants is None:
+        max_tenants = int(getattr(first_cfg, "gateway_max_tenants", 8) or 8)
+    if tenant_workers is None:
+        tenant_workers = int(
+            getattr(first_cfg, "gateway_tenant_workers", 0) or 0)
+
+    # -- admission (quota NACKs are typed, never silent) -------------------
+    admitted: list = []
+    results: Dict[str, dict] = {}
+    rejected: Dict[str, str] = {}
+    for tid, dataset, config, worker_num in tenants:
+        tid = str(tid)
+        if tid in results:
+            raise ValueError(f"duplicate tenant id {tid!r}")
+        reason = None
+        if tenant_workers and int(worker_num) > tenant_workers:
+            reason = (f"worker-quota: {worker_num} workers > "
+                      f"gateway_tenant_workers {tenant_workers}")
+        elif len(admitted) >= max_tenants:
+            reason = (f"tenant-quota: gateway_max_tenants {max_tenants} "
+                      "already admitted")
+        results[tid] = {"tenant": tid, "admitted": reason is None,
+                        "reject_reason": reason, "quarantined": False,
+                        "error": None, "aggregator": None, "wire": {},
+                        "pulse_path": None, "plane": None}
+        if reason is None:
+            admitted.append((tid, dataset, config, int(worker_num)))
+        else:
+            rejected[tid] = reason
+            LOG.warning("gateway: rejected tenant %r (%s)", tid, reason)
+    if not admitted:
+        return results
+
+    # -- shared transport + mux -------------------------------------------
+    size = 1 + sum(w for _, _, _, w in admitted)
+    if transport == "local":
+        make_bare = _make_local_factory(size, wire_roundtrip)
+    elif transport == "grpc":
+        make_bare = _make_grpc_factory(size, grpc_base_port)
+    else:
+        raise ValueError(f"unsupported gateway transport {transport!r}")
+
+    from fedml_tpu.obs import default_registry
+
+    gw_comm = make_bare(0)
+    mux = GatewayMux(gw_comm, default_registry())
+    mux.rejected.update(rejected)
+    gw_comm.add_observer(mux)
+    gw_thread = threading.Thread(target=gw_comm.handle_receive_message,
+                                 daemon=True, name="gateway-mux")
+
+    # -- per-tenant lanes + workers ----------------------------------------
+    lanes: Dict[str, TenantLane] = {}
+    threads: list = []
+    base = 1
+    for tid, dataset, config, worker_num in admitted:
+        cap = int(getattr(config, "wire_inbox_cap", 0) or 0)
+        if inbox_cap is not None:
+            cap = int(inbox_cap)
+        if cap > 0 and not getattr(config, "wire_reliable", False):
+            # WIRE_BUSY is consumed by the sender's reliable layer; a
+            # capped lane without it would push back into a void and the
+            # held uploads would simply be lost
+            raise ValueError(
+                f"tenant {tid!r}: wire_inbox_cap {cap} requires "
+                "wire_reliable=True (WIRE_BUSY push-back needs the "
+                "sender's reliable layer to hold and re-arm)")
+        pulse_path = (os.path.join(pulse_dir, f"pulse-{tid}.jsonl")
+                      if pulse_dir else None)
+        lane = TenantLane(tid, config, worker_num, base - 1, cap, pulse_path)
+        lanes[tid] = lane
+        mux.lanes[tid] = lane
+        results[tid]["pulse_path"] = pulse_path
+
+        # deterministic per-tenant state, exactly the standalone launcher's
+        # construction (run_fedavg_edge): model + root key + aggregator are
+        # pure in config.seed, shared across the tenant's rank threads
+        bundle = create_model(config.model, dataset.class_num,
+                              input_shape=dataset.train_x.shape[2:] or None)
+        root_key = seed_everything(config.seed)
+        aggregator = make_aggregator(bundle.init(root_key), worker_num,
+                                     config, dataset=dataset, bundle=bundle)
+        lane.aggregator = aggregator
+        results[tid]["aggregator"] = aggregator
+
+        def lane_body(lane=lane, dataset=dataset, config=config,
+                      worker_num=worker_num, bundle=bundle,
+                      root_key=root_key, aggregator=aggregator):
+            comm = None
+            try:
+                # EVERYTHING the lane constructs — the reliable layer's
+                # wire group, the server's stale-upload lane, pulse
+                # snapshots — attaches to THIS tenant's registry/plane
+                with registry_scope(lane.registry), plane_scope(lane.plane):
+                    link = TenantLink(gw_comm, lane.inbox, lane.tenant,
+                                      lane.base_rank)
+                    comm = link
+                    if getattr(config, "wire_reliable", False):
+                        b, c, m = retry_schedule(config)
+                        comm = ReliableCommManager(
+                            link, rank=0, retry_base_s=b, retry_cap_s=c,
+                            retry_max=m,
+                            drain_timeout_s=retry_budget_s(config) + 0.5)
+                    lane.comm = comm
+                    mgr = build_edge_rank(dataset, config, 0,
+                                          worker_num + 1, comm,
+                                          bundle=bundle, root_key=root_key,
+                                          aggregator=aggregator)
+                    mgr.tenant = lane.tenant
+                    mgr.run()
+            except FederationHealthError as e:
+                lane.error = str(e)
+                mux.quarantine(lane.tenant, str(e))
+            except BaseException as e:
+                lane.error = repr(e)
+                mux.quarantine(lane.tenant, f"lane crashed: {e!r}")
+            finally:
+                if comm is not None:
+                    try:
+                        comm.stop_receive_message()
+                    except Exception:
+                        pass
+                lane.plane.close()
+
+        threads.append(threading.Thread(target=lane_body, daemon=True,
+                                        name=f"lane-{tid}"))
+
+        for local_r in range(1, worker_num + 1):
+            global_r = lane.base_rank + local_r
+
+            def worker_body(lane=lane, dataset=dataset, config=config,
+                            worker_num=worker_num, bundle=bundle,
+                            root_key=root_key, local_r=local_r,
+                            global_r=global_r):
+                try:
+                    # worker wire counters (reliable retransmits, chaos
+                    # fates) land in the tenant registry too — the
+                    # cross-tenant leakage pin reads them there
+                    with registry_scope(lane.registry):
+                        bare = make_bare(global_r)
+                        chan = TenantChannel(bare, lane.tenant, global_r)
+                        stack = build_wire_stack(chan, config, local_r)
+                        mgr = build_edge_rank(dataset, config, local_r,
+                                              worker_num + 1, stack,
+                                              bundle=bundle,
+                                              root_key=root_key)
+                        mgr.tenant = lane.tenant
+                        mgr.run()
+                except BaseException as e:
+                    if lane.error is None and not lane.quarantined:
+                        lane.error = f"worker {local_r}: {e!r}"
+
+            threads.append(threading.Thread(
+                target=worker_body, daemon=True,
+                name=f"{tid}-rank{local_r}"))
+        base += worker_num
+
+    # -- run ---------------------------------------------------------------
+    gw_thread.start()
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout
+    hung = []
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            hung.append(t.name)
+    if hung:
+        for lane in lanes.values():
+            if not lane.quarantined and lane.error is None:
+                lane.error = f"timeout: threads still alive: {hung}"
+            if lane.comm is not None:
+                try:
+                    lane.comm.stop_receive_message()
+                except Exception:
+                    pass
+    gw_comm.stop_receive_message()
+    gw_thread.join(timeout=5.0)
+
+    for tid, lane in lanes.items():
+        res = results[tid]
+        res["quarantined"] = lane.quarantined
+        res["error"] = lane.error
+        res["wire"] = lane.registry.snapshot("wire")
+        res["plane"] = lane.plane
+    if hung:
+        raise TimeoutError(
+            f"gateway run exceeded {timeout}s; hung threads: {hung}")
+    return results

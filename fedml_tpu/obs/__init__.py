@@ -67,6 +67,7 @@ from fedml_tpu.obs.health import FederationHealthError, HealthWatchdog
 from fedml_tpu.obs.live import (
     LiveExporter,
     PulsePlane,
+    plane_scope,
     pulse_enabled,
     pulse_if_enabled,
 )
@@ -75,6 +76,7 @@ from fedml_tpu.obs.registry import (
     CounterGroup,
     MetricsRegistry,
     default_registry,
+    registry_scope,
 )
 from fedml_tpu.obs.sketch import Sketch, merge_all
 from fedml_tpu.obs.tracer import (
@@ -115,9 +117,11 @@ __all__ = [
     "reset_cost_tables",
     "flush_all",
     "get_tracer",
+    "plane_scope",
     "pulse_enabled",
     "pulse_if_enabled",
     "record_cache_hit",
+    "registry_scope",
     "reset",
     "sample_device_memory",
     "set_process_index",

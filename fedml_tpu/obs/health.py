@@ -20,6 +20,13 @@ rule                severity  fires when
                               ``--health_stale_spike`` this round (late
                               retransmits of deadline-closed rounds piling
                               up — the chaos/straggler signature)
+``peer_dead``       warn      the wire ``peer_dead`` counter moved this
+                              round — a peer exhausted a message's full
+                              retry budget for the FIRST time (the reliable
+                              layer's dead-peer oracle, counted once per
+                              peer, always armed). Every edge paradigm
+                              surfaces dead workers here, not just the
+                              fedbuff ejection hook.
 ``straggler_skew``  warn      THIS round's train-ms sketch delta has
                               p99/p50 over ``--health_skew`` (>= 4 seen
                               clients; the pulse plane feeds the per-round
@@ -153,6 +160,7 @@ class HealthWatchdog:
                 f"{self.stall_sec:g}s")
         for key, rule, thresh, severity in (
                 ("gave_up", "gave_up", 1, "critical"),
+                ("peer_dead", "peer_dead", 1, "warn"),
                 ("stale_uploads", "stale_spike", self.stale_spike, "warn")):
             if thresh <= 0:
                 continue

@@ -45,8 +45,10 @@ bench enables a profiler-only plane with no stream).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -59,8 +61,8 @@ from fedml_tpu.obs.tracer import tracer_if_enabled
 
 __all__ = [
     "FederationHealthError", "LiveExporter", "PulsePlane", "configure",
-    "configure_from", "pulse_enabled", "pulse_if_enabled", "reset",
-    "session_stats",
+    "configure_from", "plane_scope", "pulse_enabled", "pulse_if_enabled",
+    "reset", "session_stats",
 ]
 
 #: registry namespaces exported as pulse "lanes" every snapshot ("packed"
@@ -170,10 +172,17 @@ class PulsePlane:
 
     def __init__(self, exporter: Optional[LiveExporter] = None,
                  profiler: Optional[ClientProfiler] = None,
-                 watchdog: Optional[HealthWatchdog] = None):
+                 watchdog: Optional[HealthWatchdog] = None,
+                 registry=None):
         self.exporter = exporter
         self.profiler = profiler
         self.watchdog = watchdog
+        #: registry whose counter lanes each snapshot reads. None (the
+        #: default) resolves per call — the calling thread's registry_scope
+        #: or the process default. A gateway tenant's plane is PINNED to
+        #: that tenant's registry so its snapshots can never pick up another
+        #: tenant's counters, whichever thread emits the round.
+        self.registry = registry
         self._t_last_ms: Optional[float] = None
         self._round_clients = 0
         self._peak = None
@@ -275,7 +284,7 @@ class PulsePlane:
             n_cohort = self._round_clients
         self._round_clients = 0
 
-        reg = default_registry()
+        reg = self.registry if self.registry is not None else default_registry()
         lanes = {}
         for ns in _LANES:
             snap = reg.snapshot(ns)
@@ -404,11 +413,32 @@ class PulsePlane:
 
 _PLANE: Optional[PulsePlane] = None
 
+#: per-thread plane override (plane_scope): the gateway runs each tenant's
+#: handler lane on its own thread under a scope, so the lane's round
+#:  boundaries pulse into that tenant's OWN stream/watchdog while the
+#: process-wide plane (if any) keeps serving everything else.
+_TLS = threading.local()
+
 
 def pulse_if_enabled() -> Optional[PulsePlane]:
-    """Hot-path gate: ``None`` while the pulse plane is off — one global
-    read, no allocation — else the active plane."""
-    return _PLANE
+    """Hot-path gate: ``None`` while the pulse plane is off — a thread-local
+    attribute read plus one global read, no allocation — else the calling
+    thread's scoped plane (``plane_scope``) or the process-wide one."""
+    plane = getattr(_TLS, "plane", None)
+    return plane if plane is not None else _PLANE
+
+
+@contextlib.contextmanager
+def plane_scope(plane: Optional[PulsePlane]):
+    """Route this THREAD's ``pulse_if_enabled()`` to ``plane`` for the
+    duration of the block (previous override restored on exit). Other
+    threads keep the process-wide plane."""
+    prev = getattr(_TLS, "plane", None)
+    _TLS.plane = plane
+    try:
+        yield plane
+    finally:
+        _TLS.plane = prev
 
 
 def pulse_enabled() -> bool:

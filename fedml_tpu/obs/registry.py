@@ -25,6 +25,7 @@ Design constraints inherited from the surfaces being unified:
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import weakref
 from typing import Iterator, Optional
@@ -159,7 +160,31 @@ class MetricsRegistry:
 
 _DEFAULT = MetricsRegistry()
 
+#: per-thread registry override (registry_scope). The gateway runs each
+#: tenant's handler lane on its own thread under a scope, so every counter
+#: surface the lane touches (reliable wire groups, the server's stale lane,
+#: pulse snapshots) attaches to THAT tenant's registry — cross-tenant
+#: counter isolation without threading a registry through every call site.
+_TLS = threading.local()
+
 
 def default_registry() -> MetricsRegistry:
-    """The process-wide registry every built-in surface attaches to."""
-    return _DEFAULT
+    """The registry the calling thread's counter surfaces attach to: the
+    thread's :func:`registry_scope` override when one is active, else the
+    process-wide default. The common (scope-less) path is two attribute
+    reads and no allocation."""
+    reg = getattr(_TLS, "registry", None)
+    return reg if reg is not None else _DEFAULT
+
+
+@contextlib.contextmanager
+def registry_scope(registry: MetricsRegistry):
+    """Route this THREAD's ``default_registry()`` calls to ``registry`` for
+    the duration of the block (re-entrant: the previous override — if any —
+    is restored on exit). Other threads are unaffected."""
+    prev = getattr(_TLS, "registry", None)
+    _TLS.registry = registry
+    try:
+        yield registry
+    finally:
+        _TLS.registry = prev

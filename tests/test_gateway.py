@@ -1,0 +1,309 @@
+"""fedgate: multi-tenant gateway isolation, backpressure, and GC pins.
+
+The gateway (distributed/gateway.py) multiplexes N federations over one
+shared transport listener. These tests pin its three contracts:
+
+- **Transparency**: one tenant through the gateway produces BIT-IDENTICAL
+  final weights to a standalone ``run_fedavg_edge`` of the same config —
+  on the local transport AND over real gRPC. The gateway is pure routing
+  plus flow control; any numeric drift is a routing bug.
+- **Isolation**: two tenants run concurrently under 20% seeded chaos and
+  both complete with exact-once upload accounting; a clean tenant sharing
+  the gateway with a chaos tenant sees ZERO retransmits (faults do not
+  leak across lanes). A tenant whose watchdog escalates (divergent loss)
+  is quarantined — its workers get a terminal eviction — while the
+  healthy tenant's weights stay bit-identical to a solo run.
+- **Backpressure**: a flooding sender against a capped lane is answered
+  with WIRE_BUSY; the lane's recorded high-water depth never exceeds
+  ``wire_inbox_cap`` and every message is still delivered exactly once
+  (push-back holds traffic at the sender, it never drops it).
+
+Plus the reliable layer's idle-pair GC: a long-lived lane hosting many
+short worker incarnations keeps O(live peers) dedup state, not
+O(ever-seen pairs) — with the retry budget keying the horizon, so GC can
+never re-admit a duplicate that could still be retransmitted.
+
+tools/gateway_sweep.py runs the wide multi-seed + flood version of these
+pins; this file is the tier-1 subset.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu import obs
+from fedml_tpu.comm.base import Observer
+from fedml_tpu.comm.flow import TenantChannel, TenantLink
+from fedml_tpu.comm.local import LocalCommunicationManager, LocalRouter
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.reliable import ReliableCommManager
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data import load_dataset
+from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+from fedml_tpu.distributed.gateway import GatewayMux, TenantLane, run_gateway
+from fedml_tpu.obs import MetricsRegistry, registry_scope
+
+pytestmark = pytest.mark.chaos
+
+WORKERS = 2
+ROUNDS = 2
+
+CHAOS = dict(wire_reliable=True, chaos_drop=0.2, chaos_dup=0.1,
+             chaos_seed=7)
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs():
+    obs.reset()
+    yield
+    obs.reset()
+    import gc
+    gc.collect()
+
+
+def _cfg(**kw):
+    base = dict(
+        model="lr", dataset="synthetic_1_1", client_num_in_total=6,
+        client_num_per_round=6, comm_round=ROUNDS, batch_size=10, lr=0.1,
+        epochs=1, frequency_of_the_test=1, seed=5, device_data="off",
+        # fast retry base (chaos recovers in milliseconds) but a deep
+        # budget (~15s): a concurrent-compile stall on the 1-core CI box
+        # must retry through, never gave_up-escalate a tenant's watchdog
+        # into quarantine mid-test (test_trace retry_max=40 precedent)
+        wire_retry_base_s=0.02, wire_retry_max=40,
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _ds():
+    return load_dataset("synthetic_1_1", num_clients=6, batch_size=10, seed=5)
+
+
+def _leaves(agg):
+    return [np.asarray(l) for l in jax.tree.leaves(agg.variables)]
+
+
+def _solo(ds, cfg):
+    agg = run_fedavg_edge(ds, cfg, worker_num=WORKERS, timeout=120)
+    return _leaves(agg)
+
+
+# -- transparency ------------------------------------------------------------
+
+def test_gateway_single_tenant_bit_identical_local():
+    ds = _ds()
+    solo_w = _solo(ds, _cfg(wire_reliable=True))
+    res = run_gateway([("only", ds, _cfg(wire_reliable=True), WORKERS)],
+                      transport="local", timeout=120)
+    r = res["only"]
+    assert r["admitted"] and not r["quarantined"] and r["error"] is None
+    assert r["aggregator"].uploads_accepted == WORKERS * ROUNDS
+    gw_w = _leaves(r["aggregator"])
+    assert all(np.array_equal(a, b) for a, b in zip(solo_w, gw_w))
+
+
+def test_gateway_single_tenant_bit_identical_grpc():
+    ds = _ds()
+    solo_w = _solo(ds, _cfg(wire_reliable=True))
+    res = run_gateway([("only", ds, _cfg(wire_reliable=True), WORKERS)],
+                      transport="grpc", grpc_base_port=57410, timeout=120)
+    r = res["only"]
+    assert r["admitted"] and not r["quarantined"] and r["error"] is None
+    assert r["aggregator"].uploads_accepted == WORKERS * ROUNDS
+    gw_w = _leaves(r["aggregator"])
+    assert all(np.array_equal(a, b) for a, b in zip(solo_w, gw_w))
+
+
+# -- isolation ---------------------------------------------------------------
+
+def test_gateway_concurrent_tenants_chaos_exact_once_no_leak():
+    ds = _ds()
+    # clean lane gets a generous retry base: with no chaos layer attached a
+    # retransmit would mean a real 0.5s ack stall, so the zero-leak asserts
+    # below can't be tripped by scheduler contention on a 1-core CI box
+    res = run_gateway(
+        [("noisy", ds, _cfg(**CHAOS), WORKERS),
+         ("clean", ds, _cfg(wire_reliable=True, wire_retry_base_s=0.5),
+          WORKERS)],
+        transport="local", timeout=120)
+    for tid in ("noisy", "clean"):
+        r = res[tid]
+        assert not r["quarantined"] and r["error"] is None, (tid, r["error"])
+        # exact-once: every round aggregated every worker's upload once
+        assert r["aggregator"].uploads_accepted == WORKERS * ROUNDS
+    # the chaos tenant's faults happened (retries in ITS registry) and did
+    # not leak: the clean lane's wire counters never saw a retransmit
+    assert res["noisy"]["wire"].get("retransmits", 0) > 0
+    assert res["clean"]["wire"].get("retransmits", 0) == 0
+    assert res["clean"]["wire"].get("dup_dropped", 0) == 0
+
+
+def test_gateway_quarantine_leaves_healthy_tenant_bit_identical():
+    ds = _ds()
+    solo_w = _solo(ds, _cfg(wire_reliable=True))
+    res = run_gateway(
+        [("bad", ds, _cfg(wire_reliable=True, health_loss_limit=1e-9),
+          WORKERS),
+         ("good", ds, _cfg(wire_reliable=True), WORKERS)],
+        transport="local", timeout=120)
+    bad, good = res["bad"], res["good"]
+    # the poisoned tenant escalated and was fault-isolated, not fatal
+    assert bad["quarantined"]
+    assert "health" in (bad["error"] or "")
+    # the healthy tenant never noticed: exact-once and bit-identical
+    assert not good["quarantined"] and good["error"] is None
+    assert good["aggregator"].uploads_accepted == WORKERS * ROUNDS
+    assert all(np.array_equal(a, b)
+               for a, b in zip(solo_w, _leaves(good["aggregator"])))
+
+
+def test_gateway_admission_quotas_reject_typed():
+    ds = _ds()
+    res = run_gateway(
+        [("a", ds, _cfg(wire_reliable=True), WORKERS),
+         ("b", ds, _cfg(wire_reliable=True), WORKERS),
+         ("big", ds, _cfg(wire_reliable=True), WORKERS + 5)],
+        transport="local", timeout=120, max_tenants=2, tenant_workers=4)
+    assert res["a"]["admitted"] and res["b"]["admitted"]
+    assert not res["big"]["admitted"]
+    # over worker quota trumps the tenant count: the reason is typed
+    assert "worker-quota" in res["big"]["reject_reason"]
+    assert res["big"]["aggregator"] is None
+
+
+# -- backpressure ------------------------------------------------------------
+
+def test_wire_busy_bounds_inbox_depth_exact_once():
+    """Flooding senders against a capped lane: depth <= cap (recorded
+    high-water, not sampled), WIRE_BUSY actually fired, and every message
+    still arrives exactly once — push-back defers, never drops."""
+    cap, senders, msgs = 4, 3, 10
+    cfg = FedConfig(model="lr", dataset="synthetic_1_1", wire_reliable=True,
+                    wire_inbox_cap=cap, wire_retry_base_s=0.02,
+                    wire_retry_max=8)
+    router = LocalRouter(1 + senders)
+    gw_comm = LocalCommunicationManager(router, 0)
+    mux = GatewayMux(gw_comm, MetricsRegistry())
+    lane = TenantLane("t", cfg, senders, 0, cap, None)
+    mux.lanes["t"] = lane
+
+    got, lock = [], threading.Lock()
+
+    class SlowCollector(Observer):
+        def receive_message(self, msg_type, msg):
+            time.sleep(0.005)   # slow drain: forces the lane over cap
+            with lock:
+                got.append(msg.get("pkt"))
+
+    lane_rel = {}
+
+    def lane_body():
+        with registry_scope(lane.registry):
+            link = TenantLink(gw_comm, lane.inbox, "t", lane.base_rank)
+            rel = ReliableCommManager(link, rank=0, retry_base_s=0.02,
+                                      retry_max=8, drain_timeout_s=2.0)
+            lane_rel["rel"] = rel
+            rel.add_observer(SlowCollector())
+            rel.handle_receive_message()
+
+    gw_comm.add_observer(mux)
+    threads = [threading.Thread(target=gw_comm.handle_receive_message,
+                                daemon=True),
+               threading.Thread(target=lane_body, daemon=True)]
+    for t in threads:
+        t.start()
+
+    def sender_body(local_r):
+        reg = MetricsRegistry()
+        with registry_scope(reg):
+            bare = LocalCommunicationManager(router, local_r)
+            chan = TenantChannel(bare, "t", local_r)
+            rel = ReliableCommManager(chan, rank=local_r, retry_base_s=0.02,
+                                      retry_max=8, drain_timeout_s=30.0)
+            rx = threading.Thread(target=rel.handle_receive_message,
+                                  daemon=True)
+            rx.start()
+            for i in range(msgs):
+                m = Message(9001, local_r, 0)
+                m.add_params("pkt", f"{local_r}:{i}")
+                rel.send_message(m)
+            rel.stop_receive_message()   # drain: block until all acked
+            rx.join(timeout=10.0)
+            assert len(rel._outstanding) == 0
+            assert rel.stats["gave_up"] == 0
+
+    senders_t = [threading.Thread(target=sender_body, args=(r,), daemon=True)
+                 for r in range(1, senders + 1)]
+    for t in senders_t:
+        t.start()
+    for t in senders_t:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "flooding sender wedged"
+
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and len(got) < senders * msgs:
+        time.sleep(0.02)
+    lane_rel["rel"].stop_receive_message()
+    gw_comm.stop_receive_message()
+
+    # exact-once delivery despite busy push-back and retransmits
+    assert len(got) == senders * msgs
+    assert len(set(got)) == senders * msgs
+    # the inbox NEVER exceeded its cap (peak is recorded on every append)
+    assert lane.inbox.peak <= cap
+    # ...and the cap actually bit: the mux pushed back at least once
+    wire = lane.registry.snapshot("wire")
+    assert wire.get("gw_busy_sent", 0) + wire.get("gw_shed_stale", 0) > 0
+
+
+# -- reliable idle-pair GC ---------------------------------------------------
+
+def test_reliable_idle_gc_bounds_dedup_state():
+    """A lane hosting many short-lived peer incarnations must not grow
+    dedup state forever: pairs idle past the GC horizon are swept by the
+    retransmit loop, while a recently-active pair survives."""
+    router = LocalRouter(1)
+    inner = LocalCommunicationManager(router, 0)
+    rel = ReliableCommManager(inner, rank=0, retry_base_s=0.01, retry_max=2,
+                              idle_gc_s=0.2)
+    try:
+        with rel._lock:
+            for i in range(300):
+                assert not rel._is_dup_and_mark((i, "dead-inc"), 0)
+        assert len(rel._seen) == 300
+        # keep ONE pair hot while the horizon passes for the other 300
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with rel._lock:
+                rel._is_dup_and_mark(("live", "inc"),
+                                     int(time.monotonic() * 1000))
+            if len(rel._seen) <= 1:
+                break
+            time.sleep(0.05)
+        assert ("live", "inc") in rel._seen
+        assert len(rel._seen) == 1, (
+            f"idle GC left {len(rel._seen)} dedup pairs alive")
+        assert len(rel._seen_touch) == 1
+    finally:
+        rel.stop_receive_message()
+
+
+def test_reliable_idle_gc_horizon_keyed_to_retry_budget():
+    """The default horizon must exceed the retry budget by a wide margin —
+    otherwise GC could forget a window while a bounded-retry duplicate can
+    still arrive, re-admitting it."""
+    router = LocalRouter(1)
+    inner = LocalCommunicationManager(router, 0)
+    rel = ReliableCommManager(inner, rank=0, retry_base_s=0.05,
+                              retry_cap_s=1.0, retry_max=10)
+    try:
+        budget = sum(rel._backoff_of(0.05, 1.0, i) for i in range(11))
+        assert rel.idle_gc_s >= max(30.0, 8.0 * budget)
+    finally:
+        rel.stop_receive_message()

@@ -586,6 +586,47 @@ def test_fedtop_once_exit_codes(tmp_path, capsys):
     capsys.readouterr()
 
 
+# -- fedtop directory (gateway) mode ----------------------------------------
+
+def test_fedtop_gateway_dir_golden(capsys):
+    """Committed multi-tenant fixture dir in, committed render out: one
+    section per pulse-<tenant>.jsonl, tenant parsed from the filename."""
+    fedtop = _load_tool("fedtop")
+    rc = fedtop.main([os.path.join(FIXTURES, "gateway"), "--once"])
+    out = capsys.readouterr().out
+    golden = open(os.path.join(FIXTURES, "fedtop_gateway.txt")).read()
+    assert rc == 0
+    assert out == golden
+
+
+def test_fedtop_gateway_dir_tenant_filter(capsys):
+    fedtop = _load_tool("fedtop")
+    rc = fedtop.main([os.path.join(FIXTURES, "gateway"), "--once",
+                      "--tenant", "beta"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tenant beta" in out and "tenant alpha" not in out
+    assert "1/1 tenant stream(s)" in out.splitlines()[0]
+
+
+def test_fedtop_gateway_dir_exit_codes(tmp_path, capsys):
+    fedtop = _load_tool("fedtop")
+    # empty directory: nothing to render
+    assert fedtop.main([str(tmp_path), "--once"]) == 2
+    # a lone healthy stream: 0
+    (tmp_path / "pulse-a.jsonl").write_text(json.dumps(
+        {"v": 1, "ts_ms": 1, "round": 0, "source": "x"}) + "\n")
+    assert fedtop.main([str(tmp_path), "--once"]) == 0
+    # ANY tenant critical makes the directory verdict critical
+    (tmp_path / "pulse-b.jsonl").write_text(json.dumps(
+        {"v": 1, "ts_ms": 1, "round": 0, "source": "x",
+         "health": {"state": "critical", "events": []}}) + "\n")
+    assert fedtop.main([str(tmp_path), "--once"]) == 1
+    # ...unless --tenant narrows to the healthy one
+    assert fedtop.main([str(tmp_path), "--once", "--tenant", "a"]) == 0
+    capsys.readouterr()
+
+
 # -- trace_report join ------------------------------------------------------
 
 def test_trace_report_joins_pulse_beside_trace(tmp_path, capsys):

@@ -103,6 +103,26 @@ METRICS = {
         lambda j: ((j.get("crossdevice") or {}).get("fedbuff") or {})
         .get("version_lag_p99"),
         "version lag p99", False),
+    # fedgate (ISSUE 16): the multi-tenant gateway block at its top tenant
+    # count. Per-tenant rounds/s is higher-is-better and gates; the p99
+    # upload latency a healthy tenant saw under the noisy neighbor and the
+    # flow-control push-back count (busy + shed) are trajectory context —
+    # a latency/shed change reads with the cap/tenant-count context, never
+    # as a bare regression. Absent on pre-ISSUE-16 artifacts (chained
+    # .get()s return None; missing keys never flake the gate).
+    "gateway_rounds_per_sec": (
+        lambda j: ((j.get("crossdevice") or {}).get("gateway") or {})
+        .get("rounds_per_sec_per_tenant"),
+        "gw rounds/s", True),
+    "gateway_upload_p99": (
+        lambda j: ((j.get("crossdevice") or {}).get("gateway") or {})
+        .get("healthy_upload_p99_ms"),
+        "gw upload p99", False),
+    "gateway_pushback": (
+        lambda j: (lambda g: (g.get("busy_sent", 0) + g.get("shed_stale", 0))
+                   if g else None)(
+            (j.get("crossdevice") or {}).get("gateway")),
+        "gw busy+shed", False),
     # fedsched (ISSUE 13): the cross-device block's cohort size and cohort
     # policy — context columns for the clients/s trajectory (the r06 jump
     # reads as "1000-client scheduled cohorts", not as free speed). Absent

@@ -11,6 +11,15 @@ watchdog's verdict:
 
     python tools/fedtop.py /tmp/run/pulse.jsonl            # live (1s poll)
     python tools/fedtop.py /tmp/run/pulse.jsonl --once     # one snapshot
+    python tools/fedtop.py /tmp/gw --once                  # gateway dir
+    python tools/fedtop.py /tmp/gw --tenant beta           # one tenant, live
+
+DIRECTORY MODE: pointing fedtop at a directory instead of a file tails
+every ``pulse-<tenant>.jsonl`` the federation gateway
+(distributed/gateway.py ``--pulse_dir``) writes there — one section per
+tenant, the tenant name parsed from the filename. ``--tenant NAME``
+narrows to one stream. New tenant streams appearing mid-watch are picked
+up on the next poll. Single-file output is unchanged by this mode.
 
 ``--once`` renders the file's final state and exits — the CI mode (and the
 goldenable one: output derives ONLY from file contents, never the wall
@@ -18,7 +27,9 @@ clock). Live mode redraws on every appended snapshot and flags a stream
 that stopped moving (no new snapshot for ``--stall`` seconds).
 
 Exit codes (``--once``): 0 healthy/warn; 1 the stream's health state is
-critical; 2 no file / no parseable snapshots. Live mode exits 0 on Ctrl-C.
+critical (directory mode: ANY tenant critical); 2 no file / no parseable
+snapshots (directory mode: no streams with snapshots). Live mode exits 0
+on Ctrl-C.
 
 Pure text over the JSONL contract — no jax import, no fedml_tpu import, so
 it can run on a laptop against a file rsync'd (or tail -f | ssh'd) from
@@ -28,6 +39,7 @@ the TPU host.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -263,9 +275,103 @@ def render(snaps: list[dict], path: str, stalled_s: float = 0.0) -> str:
     return "\n".join(lines)
 
 
+def tenant_of(path: str) -> str:
+    """Tenant id from a gateway stream filename: the part of the basename
+    between ``pulse-`` and ``.jsonl`` (``pulse-beta.jsonl`` → ``beta``)."""
+    return os.path.basename(path)[len("pulse-"):-len(".jsonl")]
+
+
+def discover_streams(root: str, tenant: str | None = None) -> list[str]:
+    """The gateway's per-tenant streams under ``root``, sorted by tenant
+    name for a stable section order; ``tenant`` narrows to one."""
+    paths = sorted(glob.glob(os.path.join(root, "pulse-*.jsonl")),
+                   key=tenant_of)
+    if tenant is not None:
+        paths = [p for p in paths if tenant_of(p) == tenant]
+    return paths
+
+
+def render_dir(sections: list[tuple[str, str, list[dict], float]],
+               root: str) -> str:
+    """Directory-mode body: a gateway header, then one ``render`` section
+    per tenant stream (tenant, path, snaps, stalled_s), skipping streams
+    with no snapshots yet. File-only, like ``render`` — goldenable."""
+    live = [s for s in sections if s[2]]
+    # basename only, like ``render`` — keeps the golden path-independent
+    lines = [f"fedgate {os.path.basename(os.path.normpath(root))} · "
+             f"{len(live)}/{len(sections)} tenant stream(s) with snapshots"]
+    for tenant, path, snaps, stalled_s in live:
+        lines.append("")
+        lines.append(f"── tenant {tenant} " + "─" * max(1, 50 - len(tenant)))
+        lines.append(render(snaps, path, stalled_s=stalled_s))
+    return "\n".join(lines)
+
+
+def _main_dir(args) -> int:
+    paths = discover_streams(args.pulse, args.tenant)
+    sections = []
+    for p in paths:
+        snaps, _ = read_snapshots(p)
+        sections.append((tenant_of(p), p, snaps, 0.0))
+    if args.once:
+        if not any(s[2] for s in sections):
+            print(f"fedtop: no pulse-*.jsonl snapshots in {args.pulse}",
+                  file=sys.stderr)
+            return 2
+        print(render_dir(sections, args.pulse))
+        states = [(s[2][-1].get("health") or {}).get("state")
+                  for s in sections if s[2]]
+        return 1 if "critical" in states else 0
+
+    tails: dict[str, PulseTail] = {}
+    snaps_by: dict[str, list[dict]] = {}
+    last_new: dict[str, float] = {}
+    for tenant, p, snaps, _ in sections:
+        tail = PulseTail(p)
+        _, tail.offset = read_snapshots(p)   # initial read consumed to EOF
+        tails[p], snaps_by[p] = tail, snaps
+        last_new[p] = time.monotonic()
+    try:
+        while True:
+            now = time.monotonic()
+            body_sections = []
+            for p in sorted(tails, key=tenant_of):
+                stalled = now - last_new[p]
+                body_sections.append(
+                    (tenant_of(p), p, snaps_by[p],
+                     stalled if stalled > args.stall else 0.0))
+            if any(s[2] for s in body_sections):
+                sys.stdout.write("\x1b[2J\x1b[H"
+                                 + render_dir(body_sections, args.pulse)
+                                 + "\n")
+            else:
+                sys.stdout.write(
+                    f"fedtop: waiting for pulse-*.jsonl in {args.pulse} "
+                    "...\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+            for p in discover_streams(args.pulse, args.tenant):
+                if p not in tails:   # tenant stream born mid-watch
+                    tails[p] = PulseTail(p)
+                    snaps_by[p] = []
+                    last_new[p] = time.monotonic()
+            for p, tail in tails.items():
+                fresh, reset = tail.poll()
+                if reset:
+                    snaps_by[p].clear()
+                if fresh:
+                    snaps_by[p].extend(fresh)
+                    del snaps_by[p][:-4096]
+                    last_new[p] = time.monotonic()
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("pulse", help="pulse.jsonl written by --pulse_path")
+    ap.add_argument("pulse", help="pulse.jsonl written by --pulse_path, or "
+                                  "a gateway --pulse_dir directory of "
+                                  "pulse-<tenant>.jsonl streams")
     ap.add_argument("--once", action="store_true",
                     help="render the final state once and exit (CI mode)")
     ap.add_argument("--interval", type=float, default=1.0,
@@ -273,7 +379,12 @@ def main(argv=None) -> int:
     ap.add_argument("--stall", type=float, default=30.0,
                     help="live mode: flag the stream after this many "
                          "seconds without a new snapshot")
+    ap.add_argument("--tenant", default=None,
+                    help="directory mode: show only this tenant's stream")
     args = ap.parse_args(argv)
+
+    if os.path.isdir(args.pulse):
+        return _main_dir(args)
 
     snaps, offset = read_snapshots(args.pulse)
     if args.once:

@@ -1,0 +1,381 @@
+"""Multi-tenant gateway sweep: isolation, quarantine, and flood gates.
+
+For every seed this tool runs, against one in-process federation gateway
+(distributed/gateway.py, local transport, threads):
+
+1. **Isolation gate**: five tenants through one gateway — a chaos tenant
+   (20% drop/dup), a second chaos tenant on a different seed, a clean
+   tenant, a poisoned tenant whose watchdog must escalate
+   (``health_loss_limit`` ~0), and an over-quota tenant that must be
+   REJECTED at admission. Checks: the poisoned tenant is quarantined while
+   both chaos tenants complete with exact-once upload accounting; the
+   clean tenant's final weights are BIT-IDENTICAL to a standalone
+   ``run_fedavg_edge`` of the same config (the gateway is pure routing)
+   and its wire lane shows ZERO retransmits (no cross-tenant leakage);
+   the rejected tenant carries a typed ``tenant-quota`` reason; every
+   healthy tenant streamed a ``pulse-<tenant>.jsonl``.
+2. **Flood gate**: hundreds of SIMULATED workers (``--tenants`` x
+   ``--senders`` reliable sender stacks, no training) hammer capped lanes
+   through the real :class:`GatewayMux`. Checks: every lane's inbox depth
+   stayed <= ``--cap`` (peak is recorded, not sampled), every message is
+   delivered EXACTLY once to its own tenant and never to another, no
+   sender gave up or was evicted, and nothing leaks (all pending maps
+   empty at drain).
+
+Every phase executes under a watchdog: a wedged lane, a lost eviction or
+a deadlocked teardown surfaces as a reported hang (non-zero exit), never
+a silent CI stall — this slots next to tools/fedbuff_ab.py and
+tools/chaos_sweep.py.
+
+Usage: python tools/gateway_sweep.py [out.json] [--seeds N] [--tenants T]
+                                     [--senders S] [--msgs M] [--cap C]
+                                     [--timeout S]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _arg(argv, flag, default, cast=float):
+    if flag in argv:
+        return cast(argv[argv.index(flag) + 1])
+    return default
+
+
+def _run_with_watchdog(fn, timeout: float):
+    """fn() on a daemon thread; (result, error_str). A hang cannot wedge
+    the sweep — the daemon thread dies with the process."""
+    out: dict = {}
+
+    def target():
+        try:
+            out["result"] = fn()
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            out["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        return None, f"hang: run exceeded {timeout:.0f}s watchdog"
+    return out.get("result"), out.get("error")
+
+
+# -- phase 1: federation-level isolation -------------------------------------
+
+def _isolation_phase(seed: int, timeout: float, pulse_root: str):
+    import jax
+    import numpy as np
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+    from fedml_tpu.distributed.gateway import run_gateway
+
+    workers, rounds = 2, 2
+    cohort = workers * 2
+    ds = make_synthetic_classification(
+        f"gwsweep-{seed}", (16,), 5, cohort, records_per_client=20,
+        partition_method="hetero", partition_alpha=0.5, batch_size=8,
+        seed=seed)
+
+    def cfg(**kw):
+        base = dict(
+            model="lr", dataset="gwsweep", client_num_in_total=cohort,
+            client_num_per_round=cohort, comm_round=rounds, batch_size=8,
+            epochs=1, lr=0.1, seed=seed, frequency_of_the_test=1,
+            device_data="off", wire_reliable=True,
+            # fast base so chaos retries resolve in milliseconds, but a DEEP
+            # budget (~37s worst case): 5 tenants jit-compiling concurrently
+            # on a 1-core box can stall any one worker's ack for seconds,
+            # and a gave_up would escalate that tenant's own watchdog into
+            # quarantine — a timing artifact, not the isolation contract
+            # under test (same precedent as test_trace's retry_max=40)
+            wire_retry_base_s=0.05, wire_retry_max=40)
+        base.update(kw)
+        return FedConfig(**base)
+
+    def leaves(agg):
+        return [np.asarray(l) for l in jax.tree.leaves(agg.variables)]
+
+    # standalone reference for the bit-identity pin (same config/seed)
+    solo = run_fedavg_edge(ds, cfg(), worker_num=workers, timeout=timeout)
+    solo_w = leaves(solo)
+
+    pulse_dir = os.path.join(pulse_root, f"seed{seed}")
+    os.makedirs(pulse_dir, exist_ok=True)
+    res = run_gateway(
+        [("alpha", ds, cfg(chaos_drop=0.2, chaos_dup=0.1,
+                           chaos_seed=seed + 7), workers),
+         ("beta", ds, cfg(chaos_drop=0.2, chaos_dup=0.1,
+                          chaos_seed=seed + 11), workers),
+         # generous retry base: with no chaos layer a retransmit would mean
+         # a real 0.5s ack stall, so the leak check below can't be tripped
+         # by GIL contention on a 1-core box (retry config never enters the
+         # weights, so the solo bit-identity pin is unaffected)
+         ("clean", ds, cfg(wire_retry_base_s=0.5), workers),
+         ("bad", ds, cfg(health_loss_limit=1e-9), workers),
+         ("overflow", ds, cfg(), workers)],
+        transport="local", timeout=timeout, pulse_dir=pulse_dir,
+        max_tenants=4)
+
+    errs = []
+    if not res["bad"]["quarantined"]:
+        errs.append("poisoned tenant was NOT quarantined")
+    rej = res["overflow"]["reject_reason"] or ""
+    if res["overflow"]["admitted"] or "tenant-quota" not in rej:
+        errs.append(f"over-quota tenant not rejected (reason={rej!r})")
+    for tid in ("alpha", "beta", "clean"):
+        r = res[tid]
+        if r["quarantined"] or r["error"]:
+            errs.append(f"healthy tenant {tid} failed: "
+                        f"quarantined={r['quarantined']} err={r['error']}")
+            continue
+        got = r["aggregator"].uploads_accepted
+        if got != workers * rounds:
+            errs.append(f"{tid}: {got} uploads != {workers * rounds} "
+                        "(exact-once broken)")
+        if not (r["pulse_path"] and os.path.getsize(r["pulse_path"]) > 0):
+            errs.append(f"{tid}: no pulse stream at {r['pulse_path']}")
+    if res["clean"]["wire"].get("retransmits", 0) != 0:
+        errs.append("clean tenant saw retransmits: chaos LEAKED across "
+                    f"tenants (wire={res['clean']['wire']})")
+    if not res["clean"]["error"]:
+        gw_w = leaves(res["clean"]["aggregator"])
+        if not all(np.array_equal(a, b) for a, b in zip(solo_w, gw_w)):
+            errs.append("clean tenant weights != standalone run "
+                        "(gateway is not transparent)")
+    return {
+        "errors": errs,
+        "quarantined": res["bad"]["quarantined"],
+        "alpha_retransmits": res["alpha"]["wire"].get("retransmits", 0),
+        "clean_final_loss": (res["clean"]["aggregator"].test_history[-1]["loss"]
+                             if res["clean"]["aggregator"].test_history
+                             else None),
+    }
+
+
+# -- phase 2: flood of simulated workers over capped lanes -------------------
+
+def _flood_phase(seed: int, tenants: int, senders: int, msgs: int,
+                 cap: int):
+    from fedml_tpu.comm.base import Observer
+    from fedml_tpu.comm.flow import TenantChannel, TenantLink
+    from fedml_tpu.comm.local import LocalCommunicationManager, LocalRouter
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.reliable import ReliableCommManager
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.distributed.gateway import GatewayMux, TenantLane
+    from fedml_tpu.obs import MetricsRegistry, registry_scope
+
+    MSG_TYPE_PKT = 9001  # sweep-only payload type, outside the protocol
+    cfg = FedConfig(model="lr", dataset="synthetic_1_1", wire_reliable=True,
+                    wire_inbox_cap=cap, wire_retry_base_s=0.02,
+                    wire_retry_max=8, seed=seed)
+
+    size = 1 + tenants * senders
+    router = LocalRouter(size)   # shared listener, like run_gateway's
+    gw_comm = LocalCommunicationManager(router, 0)
+    mux = GatewayMux(gw_comm, MetricsRegistry())
+
+    class Collector(Observer):
+        def __init__(self):
+            self.ids: list = []
+            self.lock = threading.Lock()
+
+        def receive_message(self, msg_type, msg):
+            with self.lock:
+                self.ids.append(msg.get("pkt"))
+
+    lanes, collectors, lane_rels, lane_threads = {}, {}, {}, []
+    for t in range(tenants):
+        tid = f"t{t}"
+        base = 1 + t * senders - 1   # base_rank: global = base + local
+        lane = TenantLane(tid, cfg, senders, base, cap, None)
+        mux.lanes[tid] = lane
+        lanes[tid] = lane
+        collectors[tid] = Collector()
+
+        def lane_body(lane=lane, tid=tid):
+            with registry_scope(lane.registry):
+                link = TenantLink(gw_comm, lane.inbox, tid, lane.base_rank)
+                rel = ReliableCommManager(link, rank=0, retry_base_s=0.02,
+                                          retry_max=8, drain_timeout_s=2.0)
+                lane_rels[tid] = rel
+                rel.add_observer(collectors[tid])
+                rel.handle_receive_message()
+
+        lane_threads.append(threading.Thread(target=lane_body, daemon=True,
+                                             name=f"flood-lane-{tid}"))
+
+    gw_thread = threading.Thread(target=gw_comm.handle_receive_message,
+                                 daemon=True, name="flood-mux")
+    gw_comm.add_observer(mux)
+    gw_thread.start()
+    for t in lane_threads:
+        t.start()
+
+    sender_stats, sender_threads = [], []
+    stats_lock = threading.Lock()
+    for t in range(tenants):
+        tid = f"t{t}"
+        base = mux.lanes[tid].base_rank
+        for s in range(1, senders + 1):
+            def sender_body(tid=tid, local_r=s, global_r=base + s):
+                reg = MetricsRegistry()   # keep sender counters private
+                with registry_scope(reg):
+                    bare = LocalCommunicationManager(router, global_r)
+                    chan = TenantChannel(bare, tid, global_r)
+                    rel = ReliableCommManager(chan, rank=local_r,
+                                              retry_base_s=0.02,
+                                              retry_max=8,
+                                              drain_timeout_s=30.0)
+                    rx = threading.Thread(target=rel.handle_receive_message,
+                                          daemon=True)
+                    rx.start()
+                    for i in range(msgs):
+                        m = Message(MSG_TYPE_PKT, local_r, 0)
+                        m.add_params("pkt", f"{tid}:{local_r}:{i}")
+                        m.add_params("round_idx", i)
+                        rel.send_message(m)
+                    rel.stop_receive_message()   # drains: waits for acks
+                    rx.join(timeout=5.0)
+                    with stats_lock:
+                        sender_stats.append(
+                            (tid, dict(rel.stats), len(rel._outstanding)))
+
+            sender_threads.append(threading.Thread(
+                target=sender_body, daemon=True,
+                name=f"flood-{tid}-s{s}"))
+
+    t0 = time.perf_counter()
+    for t in sender_threads:
+        t.start()
+    hung = []
+    for t in sender_threads:
+        t.join(timeout=60.0)
+        if t.is_alive():
+            hung.append(t.name)
+    elapsed = time.perf_counter() - t0
+    for tid, rel in lane_rels.items():
+        rel.stop_receive_message()
+    gw_comm.stop_receive_message()
+
+    errs = []
+    if hung:
+        errs.append(f"hang: {len(hung)} sender(s) wedged: {hung[:4]}")
+    expect = senders * msgs
+    for tid in lanes:
+        ids = collectors[tid].ids
+        if len(ids) != expect or len(set(ids)) != expect:
+            errs.append(f"{tid}: delivered {len(ids)} "
+                        f"({len(set(ids))} unique) != {expect} exact-once")
+        foreign = [i for i in set(ids) if not str(i).startswith(tid + ":")]
+        if foreign:
+            errs.append(f"{tid}: CROSS-TENANT LEAK: {foreign[:4]}")
+        peak = lanes[tid].inbox.peak
+        if cap > 0 and peak > cap:
+            errs.append(f"{tid}: inbox peak {peak} exceeded cap {cap}")
+    gave_up = sum(st["gave_up"] for _, st, _ in sender_stats)
+    evicted = sum(st["evicted"] for _, st, _ in sender_stats)
+    leaked = sum(pend for _, _, pend in sender_stats)
+    if gave_up or evicted:
+        errs.append(f"senders gave_up={gave_up} evicted={evicted} "
+                    "(busy push-back burned retries)")
+    if leaked:
+        errs.append(f"leak: {leaked} message(s) still pending after drain")
+    busy = sum(l.registry.snapshot("wire").get("gw_busy_sent", 0)
+               for l in lanes.values())
+    shed = sum(l.registry.snapshot("wire").get("gw_shed_stale", 0)
+               for l in lanes.values())
+    return {
+        "errors": errs,
+        "simulated_workers": tenants * senders,
+        "messages": tenants * expect,
+        "msgs_per_sec": round(tenants * expect / elapsed, 1),
+        "busy_sent": busy,
+        "shed_stale": shed,
+        "inbox_peaks": {tid: lanes[tid].inbox.peak for tid in lanes},
+    }
+
+
+def main(argv):
+    out_path = argv[0] if argv and not argv[0].startswith("-") else None
+    seeds = _arg(argv, "--seeds", 1, int)
+    tenants = _arg(argv, "--tenants", 4, int)
+    senders = _arg(argv, "--senders", 50, int)
+    msgs = _arg(argv, "--msgs", 4, int)
+    cap = _arg(argv, "--cap", 8, int)
+    timeout = _arg(argv, "--timeout", 180.0)
+
+    import tempfile
+
+    from fedml_tpu.core.config import FedConfig
+    from fedml_tpu.data.synthetic import make_synthetic_classification
+    from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+
+    # absorb the jitted local-train compile OUTSIDE the gated runs: a
+    # multi-second compile inside a worker handler stalls its receive loop
+    # past the fast gave-up budget and reads as a dead peer
+    warm_ds = make_synthetic_classification(
+        "gwsweep-0", (16,), 5, 4, records_per_client=20,
+        partition_method="hetero", partition_alpha=0.5, batch_size=8, seed=0)
+    run_fedavg_edge(warm_ds, FedConfig(
+        model="lr", dataset="gwsweep", client_num_in_total=4,
+        client_num_per_round=4, comm_round=1, batch_size=8, epochs=1,
+        lr=0.1, seed=0, frequency_of_the_test=10_000, device_data="off"),
+        worker_num=2)
+
+    pulse_root = tempfile.mkdtemp(prefix="gwsweep-pulse-")
+    results, failed = [], 0
+    for seed in range(seeds):
+        rec = {"seed": seed, "ok": False}
+        iso, err = _run_with_watchdog(
+            lambda: _isolation_phase(seed, timeout, pulse_root), timeout)
+        if err is None and iso["errors"]:
+            err = "; ".join(iso["errors"])
+        if err is None:
+            rec["isolation"] = iso
+            flood, err = _run_with_watchdog(
+                lambda: _flood_phase(seed, tenants, senders, msgs, cap),
+                timeout)
+            if err is None and flood["errors"]:
+                err = "; ".join(flood["errors"])
+            if err is None:
+                rec["flood"] = flood
+                rec["ok"] = True
+        if not rec["ok"]:
+            rec["error"] = err
+            failed += 1
+            print(f"seed {seed}: FAIL ({err})", file=sys.stderr)
+        else:
+            print(f"seed {seed}: ok ({flood['simulated_workers']} simulated "
+                  f"workers, {flood['msgs_per_sec']} msg/s, "
+                  f"busy {flood['busy_sent']}, shed {flood['shed_stale']})")
+        results.append(rec)
+
+    summary = {"seeds": seeds, "failed": failed, "tenants": tenants,
+               "senders": senders, "msgs": msgs, "cap": cap,
+               "results": results}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps({"seeds": seeds, "failed": failed}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    rc = main(sys.argv[1:])
+    # hard exit: a genuinely wedged run leaks daemon federation threads
+    # whose teardown would otherwise block interpreter exit — the exact
+    # CI stall the watchdog exists to prevent
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)
