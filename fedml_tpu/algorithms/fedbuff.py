@@ -132,7 +132,10 @@ class FedBuffBuffer:
 
     @property
     def ready(self) -> bool:
-        return self.pending >= self.k
+        # locked: fold() bumps pending on whichever thread delivers the
+        # contribution; a torn check here could miss the K-th fold
+        with self._lock:
+            return self.pending >= self.k
 
     def emit(self, params: Pytree) -> tuple[Pytree, dict]:
         """Close the pending buffer into a new model version:

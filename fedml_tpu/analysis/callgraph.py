@@ -102,6 +102,13 @@ class TracedGraph:
                     self._add_root(fn, info)
             return
         if isinstance(arg, ast.Call):
+            # jit(partial(fn, ...)) / jit(partial(self._method, ...)): the
+            # wrapped callable is the traced program — recurse on it
+            d = dotted_name(arg.func)
+            if d and resolve_dotted_head(mod, d).split(".")[-1] == "partial" \
+                    and arg.args:
+                self._mark_call_arg(mod, scopes, arg.args[0], info)
+                return
             # jit(make_fn(...)): the factory body runs at build time but the
             # functions it returns are the traced program
             fns: Set[FunctionInfo] = set()

@@ -42,6 +42,19 @@ RULES: Dict[str, str] = {
         "span wrapper (override _run_round_inner, delegate to super(), or "
         "open the span) so no paradigm drops out of the round timeline"
     ),
+    "unguarded-shared-write": (
+        "a write to state shared across thread roots at a site that does "
+        "not hold the lock guarding the majority of that field's accesses"
+    ),
+    "check-then-act": (
+        "a read of a lock-guarded shared field outside its guard — the "
+        "len-check-then-pop atomicity hole: the checked value can change "
+        "before the act runs"
+    ),
+    "blocking-under-lock": (
+        "sleep/join/Queue.put/send_message/future-result, or acquiring a "
+        "different lock, while holding one — the stall/deadlock shape"
+    ),
     "bad-suppression": (
         "a fedlint suppression comment names a rule that does not exist"
     ),

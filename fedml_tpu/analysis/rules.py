@@ -432,6 +432,35 @@ def check_trace_coverage(pkg: PackageIndex, graph: TracedGraph) -> List[Finding]
     return out
 
 
+def check_unguarded_shared_write(
+    pkg: PackageIndex, graph: TracedGraph
+) -> List[Finding]:
+    """fedrace (ISSUE 17): writes to thread-shared fields outside the lock
+    that guards the majority of their accesses. The whole model — thread
+    roots, guarded-by inference, the __init__ single-writer carve-out —
+    lives in analysis/threads.py and is built once per package."""
+    from fedml_tpu.analysis import threads
+    return threads.model_for(pkg).findings("unguarded-shared-write")
+
+
+def check_check_then_act(
+    pkg: PackageIndex, graph: TracedGraph
+) -> List[Finding]:
+    """fedrace (ISSUE 17): reads of a guarded shared field outside its
+    guard — the value checked can change before the acting write runs."""
+    from fedml_tpu.analysis import threads
+    return threads.model_for(pkg).findings("check-then-act")
+
+
+def check_blocking_under_lock(
+    pkg: PackageIndex, graph: TracedGraph
+) -> List[Finding]:
+    """fedrace (ISSUE 17): sleep/join/put/send_message or second-lock
+    acquisition while holding a lock — every contender stalls with it."""
+    from fedml_tpu.analysis import threads
+    return threads.model_for(pkg).findings("blocking-under-lock")
+
+
 #: checkable rule-id -> implementation (bad-suppression is emitted by the
 #: suppression parser, not a checker)
 CHECKS = {
@@ -441,4 +470,7 @@ CHECKS = {
     "protocol-exhaustiveness": check_protocol_exhaustiveness,
     "config-flag-drift": check_config_flag_drift,
     "trace-coverage": check_trace_coverage,
+    "unguarded-shared-write": check_unguarded_shared_write,
+    "check-then-act": check_check_then_act,
+    "blocking-under-lock": check_blocking_under_lock,
 }

@@ -1,0 +1,979 @@
+"""fedrace: static thread-safety model of the package (DESIGN.md §20).
+
+Three layers, all pure ``ast`` on top of the fedlint index:
+
+1. **Thread-root inference** — every entry point that can run concurrently
+   with other package code: ``threading.Thread``/``threading.Timer``
+   targets (named functions, nested defs, lambdas, ``functools.partial``
+   wrappers, ``self.method`` bound methods), handlers passed to
+   ``register_message_receive_handler``, ``receive_message`` of anything
+   handed to ``add_observer``, gRPC ``*Servicer`` methods,
+   ``atexit.register`` hooks, executor ``.submit`` targets, and
+   ``on_*``-hook attribute assignments (the reliable layer's ``on_gave_up``
+   fires on its retransmit thread). Each root is closed over the
+   intra-package call graph: lexical names, ``self.method`` dispatch
+   (through base classes), and attribute calls on receivers whose class is
+   inferred from constructor assignments / parameter annotations /
+   container-element stores.
+2. **Shared-state index + guarded-by inference** — every ``self.<attr>`` /
+   typed-receiver-attribute / module-global access in the package, keyed by
+   (class, attribute), with the set of locks held at the access site. A
+   field is *shared* when its accesses span >= 2 concurrent roots (a root
+   spawned inside a loop, a servicer method, or an executor target counts
+   twice — it runs concurrently with itself) and at least one root-reachable
+   write exists. Its *guard* is the lock held at the majority of access
+   sites (at least two locked sites, no fewer than the unlocked ones).
+   Accesses inside ``__init__`` are single-writer-before-thread-start and
+   are excluded entirely. A ``_private`` helper whose every intra-class
+   callsite holds a lock inherits that lock (``BoundedInbox._append`` runs
+   under the caller's ``_cv``); helpers that are themselves thread roots or
+   are called from outside their class inherit nothing.
+3. **Atomicity lints** — the three checkers ``analysis/rules.py`` exposes:
+
+   - ``unguarded-shared-write``: a write to a guarded shared field at a
+     site not holding the inferred guard.
+   - ``check-then-act``: a *read* of a guarded shared field outside its
+     guard. The canonical failure is len-check-then-pop: the checked value
+     is stale by the time the act runs. Every safe consumer of a
+     majority-guarded field holds the guard.
+   - ``blocking-under-lock``: ``time.sleep``, thread ``join()``, blocking
+     ``Queue.put``, ``send_message``, future ``.result()``, or acquiring /
+     waiting on a *different* known lock while holding one — the
+     stall/deadlock shape the gateway's blocking-poster flow control makes
+     live.
+
+Known false-positive shapes (and the suppression policy for each) are
+documented in DESIGN.md §11; deliberate lock-free contracts (CounterGroup's
+single-store monotonic counters, double-checked init) carry
+``# fedlint: disable=<rule>`` with a written justification at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from fedml_tpu.analysis.findings import Finding
+from fedml_tpu.analysis.index import (
+    FunctionInfo,
+    ModuleInfo,
+    PackageIndex,
+    Resolver,
+    ScopeNode,
+    dotted_name,
+    resolve_dotted_head,
+)
+
+#: threading constructors that produce a lock-like (with-able) object
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+#: attribute names treated as locks even without a visible constructor
+_LOCKISH = ("lock", "cv", "cond", "mutex", "sem")
+#: method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft", "remove",
+    "clear", "update", "add", "discard", "setdefault", "sort", "popitem",
+}
+#: calls that block (or can block indefinitely) — flagged under a held lock
+_BLOCKING_ATTRS = {"join", "put", "send_message", "result"}
+
+ClassKey = Tuple[str, str]          # (modname, class name)
+LockKey = tuple                     # ("A", mod, cls, attr) | ("G", mod, name)
+FieldKey = tuple                    # ("attr", mod, cls, attr) | ("global", mod, name)
+
+
+class ThreadRoot:
+    """One concurrent entry point."""
+
+    __slots__ = ("fn", "kind", "lineno", "multi")
+
+    def __init__(self, fn: FunctionInfo, kind: str, lineno: int, multi: bool):
+        self.fn = fn
+        self.kind = kind      # thread|timer|handler|observer|servicer|atexit|callback|executor
+        self.lineno = lineno  # the spawn/registration site
+        self.multi = multi    # may run concurrently with ITSELF
+
+    def label(self) -> str:
+        return f"{self.fn.qualname}[{self.kind}]"
+
+
+class _Access:
+    __slots__ = ("field", "write", "lineno", "fn", "held", "in_init")
+
+    def __init__(self, field, write, lineno, fn, held, in_init):
+        self.field = field
+        self.write = write
+        self.lineno = lineno
+        self.fn = fn
+        self.held: frozenset = held
+        self.in_init = in_init
+
+
+class _Blocking:
+    __slots__ = ("lineno", "fn", "what", "held")
+
+    def __init__(self, lineno, fn, what, held):
+        self.lineno = lineno
+        self.fn = fn
+        self.what = what
+        self.held: frozenset = held
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """["self", "a", "b"] for ``self.a.b``; None for non-Name heads."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+class ThreadModel:
+    """The whole fedrace model for one PackageIndex (built once, cached)."""
+
+    def __init__(self, pkg: PackageIndex):
+        self.pkg = pkg
+        self.resolver = Resolver(pkg)
+        #: (modname, cls) -> {attr -> set of ClassKey} (instance types)
+        self.attr_types: Dict[ClassKey, Dict[str, Set[ClassKey]]] = {}
+        #: (modname, cls) -> {attr -> set of ClassKey} (container elements)
+        self.elem_types: Dict[ClassKey, Dict[str, Set[ClassKey]]] = {}
+        #: (modname, cls) -> {lock attr -> canonical attr}
+        self.lock_attrs: Dict[ClassKey, Dict[str, str]] = {}
+        #: modname -> module-level lock names
+        self.module_locks: Dict[str, Set[str]] = {}
+        #: modname -> module-level single-Name bindings (global candidates)
+        self.module_names: Dict[str, Set[str]] = {}
+        self.roots: Dict[FunctionInfo, ThreadRoot] = {}
+        self.roots_reaching: Dict[FunctionInfo, Set[FunctionInfo]] = {}
+        self.accesses: List[_Access] = []
+        self.blocking: List[_Blocking] = []
+        self._edges: Dict[FunctionInfo, Set[FunctionInfo]] = {}
+        #: callee -> list of (caller fn, held frozenset) for self.-calls
+        self._self_callsites: Dict[FunctionInfo, List[tuple]] = {}
+        #: functions invoked through a typed (non-self) receiver
+        self._ext_called: Set[FunctionInfo] = set()
+
+        self._collect_types()
+        self._find_roots()
+        for mod in self.pkg.modules:
+            for fn in mod.functions:
+                self._scan_function(fn)
+        self._inherit_helper_locks()
+        self._close_roots()
+        self._findings: Optional[Dict[str, List[Finding]]] = None
+
+    # ------------------------------------------------------------ types
+    def _resolve_class(self, mod: ModuleInfo, name: str) -> Optional[ClassKey]:
+        if name in mod.classes:
+            return (mod.modname, name)
+        target = mod.imports.get(name)
+        if target is not None:
+            tmod = self.pkg.by_modname.get(target[0])
+            if tmod is not None and target[1] in tmod.classes:
+                return (tmod.modname, target[1])
+        return None
+
+    def _resolve_dotted_class(self, mod: ModuleInfo, node: ast.AST
+                              ) -> Optional[ClassKey]:
+        d = dotted_name(node)
+        if d is None:
+            return None
+        if "." not in d:
+            return self._resolve_class(mod, d)
+        real = resolve_dotted_head(mod, d)
+        head, _, tail = real.rpartition(".")
+        tmod = self.pkg.by_modname.get(head)
+        if tmod is not None and tail in tmod.classes:
+            return (tmod.modname, tail)
+        return None
+
+    def _ann_class(self, mod: ModuleInfo, ann) -> Optional[ClassKey]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Subscript):
+            d = dotted_name(ann.value)
+            if d and d.split(".")[-1] == "Optional":
+                return self._ann_class(mod, ann.slice)
+            return None
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            return self._resolve_dotted_class(mod, ann)
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str) \
+                and ann.value.isidentifier():
+            return self._resolve_class(mod, ann.value)
+        return None
+
+    def _param_ann(self, fn: FunctionInfo, name: str) -> Optional[ClassKey]:
+        f: Optional[FunctionInfo] = fn
+        while f is not None:
+            if not isinstance(f.node, ast.Lambda):
+                a = f.node.args
+                for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                    if arg.arg == name:
+                        return self._ann_class(f.module, arg.annotation)
+            f = f.parent
+        return None
+
+    def _is_lock_ctor(self, mod: ModuleInfo, value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        d = dotted_name(value.func)
+        if d is None:
+            return False
+        real = resolve_dotted_head(mod, d)
+        parts = real.split(".")
+        return parts[-1] in _LOCK_CTORS and (
+            len(parts) == 1 or parts[0] == "threading")
+
+    def _collect_types(self):
+        """Pass A: per-class attribute types + lock attrs + module locks.
+        Pass B: typed-receiver stores seen anywhere widen attr/elem types."""
+        for mod in self.pkg.modules:
+            locks: Set[str] = set()
+            names: Set[str] = set()
+            for name, value in mod.scope_binds.get(0, {}).items():
+                if self._is_lock_ctor(mod, value):
+                    locks.add(name)
+                elif name not in mod.scope_defs.get(0, {}) \
+                        and name not in mod.classes \
+                        and name not in mod.imports:
+                    names.add(name)
+            self.module_locks[mod.modname] = locks
+            self.module_names[mod.modname] = names
+
+            for fn in mod.functions:
+                if fn.cls is None or fn.parent is not None:
+                    continue
+                ckey = (mod.modname, fn.cls)
+                for node in ast.walk(fn.node):
+                    if isinstance(node, ScopeNode) and node is not fn.node:
+                        continue
+                    tgt = val = None
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        tgt, val = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        tgt, val = node.target, node.value
+                        ann = self._ann_class(mod, node.annotation)
+                        if ann and self._is_self_attr(tgt):
+                            self.attr_types.setdefault(ckey, {}).setdefault(
+                                tgt.attr, set()).add(ann)
+                    if tgt is None or val is None:
+                        continue
+                    if self._is_self_attr(tgt):
+                        if self._is_lock_ctor(mod, val):
+                            canon = tgt.attr
+                            if isinstance(val, ast.Call) and val.args and \
+                                    self._is_self_attr(val.args[0]):
+                                inner = val.args[0].attr
+                                table = self.lock_attrs.setdefault(ckey, {})
+                                canon = table.get(inner, inner)
+                            self.lock_attrs.setdefault(ckey, {})[
+                                tgt.attr] = canon
+                            continue
+                        cls = self._value_class(mod, fn, val)
+                        if cls is not None:
+                            self.attr_types.setdefault(ckey, {}).setdefault(
+                                tgt.attr, set()).add(cls)
+                    elif isinstance(tgt, ast.Subscript) \
+                            and self._is_self_attr(tgt.value):
+                        cls = self._value_class(mod, fn, val)
+                        if cls is not None:
+                            self.elem_types.setdefault(ckey, {}).setdefault(
+                                tgt.value.attr, set()).add(cls)
+        # pass B: stores through typed local receivers (mux.lanes[t] = lane)
+        for mod in self.pkg.modules:
+            for fn in mod.functions:
+                for node in ast.walk(fn.node):
+                    if not (isinstance(node, ast.Assign)
+                            and len(node.targets) == 1):
+                        continue
+                    tgt = node.targets[0]
+                    sub = isinstance(tgt, ast.Subscript)
+                    base = tgt.value if sub else tgt
+                    if not (isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id != "self"):
+                        continue
+                    rcls = self._receiver_class(fn, base.value)
+                    vcls = self._value_class(mod, fn, node.value)
+                    if rcls is None or vcls is None:
+                        continue
+                    table = self.elem_types if sub else self.attr_types
+                    table.setdefault(rcls, {}).setdefault(
+                        base.attr, set()).add(vcls)
+
+    @staticmethod
+    def _is_self_attr(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    def _value_class(self, mod: ModuleInfo, fn: Optional[FunctionInfo],
+                     value: ast.AST, _depth: int = 0) -> Optional[ClassKey]:
+        """Best-effort class of an expression's value."""
+        if _depth > 3:
+            return None
+        if isinstance(value, ast.Call):
+            if isinstance(value.func, (ast.Name, ast.Attribute)):
+                hit = self._resolve_dotted_class(mod, value.func)
+                if hit is not None:
+                    return hit
+                # x.get(k) / self.attr.get(k): container element
+                if isinstance(value.func, ast.Attribute) \
+                        and value.func.attr == "get":
+                    return self._elem_of(fn, value.func.value, _depth)
+            # plane = pulse_if_enabled(): the callee's return annotation
+            if isinstance(value.func, ast.Name):
+                scopes = fn.scope_chain() if fn is not None else []
+                fns = self.resolver.resolve(mod, scopes, value.func.id)
+                if not fns:
+                    chained = self._follow_import(mod, value.func.id)
+                    if chained is not None:
+                        fns = {chained}
+                if len(fns) == 1:
+                    callee = next(iter(fns))
+                    if not isinstance(callee.node, ast.Lambda):
+                        return self._ann_class(
+                            callee.module, callee.node.returns)
+            return None
+        if isinstance(value, ast.Name):
+            if fn is not None:
+                return self._name_class(fn, value.id, _depth)
+            return None
+        if isinstance(value, ast.Subscript):
+            return self._elem_of(fn, value.value, _depth)
+        if isinstance(value, ast.Attribute):
+            base = self._receiver_class_of(fn, value.value, _depth)
+            if base is not None:
+                hits = self.attr_types.get(base, {}).get(value.attr)
+                if hits and len(hits) == 1:
+                    return next(iter(hits))
+            return None
+        return None
+
+    def _elem_of(self, fn, container: ast.AST, depth: int) -> Optional[ClassKey]:
+        if not isinstance(container, ast.Attribute):
+            return None
+        base = self._receiver_class_of(fn, container.value, depth + 1)
+        if base is None:
+            return None
+        hits = self.elem_types.get(base, {}).get(container.attr)
+        if hits and len(hits) == 1:
+            return next(iter(hits))
+        return None
+
+    def _name_class(self, fn: FunctionInfo, name: str,
+                    _depth: int = 0) -> Optional[ClassKey]:
+        if name == "self":
+            return (fn.module.modname, fn.cls) if fn.cls else None
+        mod = fn.module
+        for scope in fn.scope_chain():
+            bound = mod.scope_binds.get(mod.scope_id(scope), {}).get(name)
+            if bound is not None:
+                return self._value_class(mod, fn, bound, _depth + 1)
+        return self._param_ann(fn, name)
+
+    def _receiver_class(self, fn: FunctionInfo,
+                        expr: ast.AST) -> Optional[ClassKey]:
+        return self._receiver_class_of(fn, expr, 0)
+
+    def _receiver_class_of(self, fn: Optional[FunctionInfo], expr: ast.AST,
+                           depth: int) -> Optional[ClassKey]:
+        if fn is None or depth > 3:
+            return None
+        if isinstance(expr, ast.Name):
+            return self._name_class(fn, expr.id, depth)
+        if isinstance(expr, (ast.Attribute, ast.Subscript, ast.Call)):
+            return self._value_class(fn.module, fn, expr, depth)
+        return None
+
+    def class_method(self, key: ClassKey, name: str,
+                     _depth: int = 0) -> Optional[FunctionInfo]:
+        """Method lookup through same-module and imported base classes."""
+        if _depth > 4:
+            return None
+        mod = self.pkg.by_modname.get(key[0])
+        if mod is None:
+            return None
+        hit = mod.classes.get(key[1], {}).get(name)
+        if hit is not None:
+            return hit
+        for base in mod.class_bases.get(key[1], []):
+            if base in mod.classes:
+                hit = self.class_method((mod.modname, base), name, _depth + 1)
+                if hit is not None:
+                    return hit
+            target = mod.imports.get(base)
+            if target is not None:
+                bmod = self.pkg.by_modname.get(target[0])
+                if bmod is not None and target[1] in bmod.classes:
+                    hit = self.class_method(
+                        (bmod.modname, target[1]), name, _depth + 1)
+                    if hit is not None:
+                        return hit
+        return None
+
+    # ------------------------------------------------------------ roots
+    def _add_root(self, fn: Optional[FunctionInfo], kind: str, lineno: int,
+                  multi: bool):
+        if fn is None:
+            return
+        prev = self.roots.get(fn)
+        if prev is None or (multi and not prev.multi):
+            self.roots[fn] = ThreadRoot(fn, kind, lineno, multi)
+
+    def _resolve_target(self, mod: ModuleInfo, owner: Optional[FunctionInfo],
+                        node: ast.AST, _depth: int = 0) -> Set[FunctionInfo]:
+        """The function(s) a spawn-target expression can invoke."""
+        if _depth > 3:
+            return set()
+        scopes = owner.scope_chain() if owner else []
+        if isinstance(node, ScopeNode):
+            info = mod.by_node.get(id(node))
+            return {info} if info else set()
+        if isinstance(node, ast.Name):
+            hits = self.resolver.resolve(mod, scopes, node.id)
+            if not hits:
+                chained = self._follow_import(mod, node.id)
+                if chained is not None:
+                    hits = {chained}
+            return hits
+        if isinstance(node, ast.Attribute):
+            # self.method / obj.method bound-method targets
+            base = (self._receiver_class(owner, node.value)
+                    if owner is not None else None)
+            if base is not None:
+                hit = self.class_method(base, node.attr)
+                if hit is not None:
+                    return {hit}
+            return set()
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d is not None and resolve_dotted_head(
+                    mod, d).split(".")[-1] == "partial" and node.args:
+                return self._resolve_target(
+                    mod, owner, node.args[0], _depth + 1)
+            # factory call: the functions it returns
+            out: Set[FunctionInfo] = set()
+            if isinstance(node.func, ast.Name):
+                for fac in self.resolver.resolve(mod, scopes, node.func.id):
+                    out |= self.resolver.returned_functions(fac)
+            return out
+        return set()
+
+    def _follow_import(self, mod: ModuleInfo, name: str,
+                       _depth: int = 0) -> Optional[FunctionInfo]:
+        """Resolve a name through a chain of re-exports (obs/__init__)."""
+        if _depth > 3:
+            return None
+        target = mod.imports.get(name)
+        if target is None or target[1] is None:
+            return None
+        tmod = self.pkg.by_modname.get(target[0])
+        if tmod is None:
+            return None
+        hit = tmod.scope_defs.get(0, {}).get(target[1])
+        if hit is not None:
+            return hit
+        return self._follow_import(tmod, target[1], _depth + 1)
+
+    @staticmethod
+    def _in_loop(stack: List[ast.AST]) -> bool:
+        return any(isinstance(n, (ast.For, ast.AsyncFor, ast.While))
+                   for n in stack)
+
+    def _find_roots(self):
+        for mod in self.pkg.modules:
+            # servicer classes: every method runs on the gRPC thread pool
+            for cls, bases in mod.class_bases.items():
+                if cls.endswith("Servicer") \
+                        or any(b.endswith("Servicer") for b in bases):
+                    for m in mod.classes.get(cls, {}).values():
+                        if not m.name.startswith("__"):
+                            self._add_root(
+                                m, "servicer", m.node.lineno, True)
+            # spawn / registration calls + on_* hook assignments, tracking
+            # the lexical loop nesting of each site
+            stack: List[tuple] = [
+                (None, [], child) for child in ast.iter_child_nodes(mod.tree)]
+            while stack:
+                owner, loops, node = stack.pop()
+                if isinstance(node, ScopeNode):
+                    owner = mod.by_node.get(id(node), owner)
+                    loops = []
+                nloops = (loops + [node]
+                          if isinstance(node, (ast.For, ast.AsyncFor,
+                                               ast.While)) else loops)
+                if isinstance(node, ast.Call):
+                    self._root_call(mod, owner, node, bool(nloops))
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and tgt.attr.startswith("on_"):
+                            for fn in self._resolve_target(
+                                    mod, owner, node.value):
+                                self._add_root(
+                                    fn, "callback", node.lineno, False)
+                stack.extend((owner, nloops, child)
+                             for child in ast.iter_child_nodes(node))
+
+    def _root_call(self, mod: ModuleInfo, owner, call: ast.Call, in_loop: bool):
+        d = dotted_name(call.func)
+        tail = d.split(".")[-1] if d else (
+            call.func.attr if isinstance(call.func, ast.Attribute) else None)
+        if tail is None:
+            return
+        real = resolve_dotted_head(mod, d) if d else tail
+
+        def kw(name):
+            for k in call.keywords:
+                if k.arg == name:
+                    return k.value
+            return None
+
+        if real in ("threading.Thread", "Thread"):
+            tgt = kw("target") or (call.args[1] if len(call.args) > 1 else None)
+            for fn in self._resolve_target(mod, owner, tgt):
+                self._add_root(fn, "thread", call.lineno, in_loop)
+        elif real in ("threading.Timer", "Timer"):
+            tgt = kw("function") or (
+                call.args[1] if len(call.args) > 1 else None)
+            for fn in self._resolve_target(mod, owner, tgt):
+                self._add_root(fn, "timer", call.lineno, in_loop)
+        elif real == "atexit.register" and call.args:
+            for fn in self._resolve_target(mod, owner, call.args[0]):
+                self._add_root(fn, "atexit", call.lineno, False)
+        elif tail == "submit" and call.args:
+            for fn in self._resolve_target(mod, owner, call.args[0]):
+                self._add_root(fn, "executor", call.lineno, True)
+        elif tail.endswith("rpc_method_handler") and call.args:
+            # grpc.unary_unary_rpc_method_handler(self._servicer): runs on
+            # the server's thread pool, concurrently with itself
+            for fn in self._resolve_target(mod, owner, call.args[0]):
+                self._add_root(fn, "servicer", call.lineno, True)
+        elif tail == "register_message_receive_handler" and len(call.args) > 1:
+            for fn in self._resolve_target(mod, owner, call.args[1]):
+                self._add_root(fn, "handler", call.lineno, False)
+        elif tail == "add_observer" and call.args:
+            base = (self._receiver_class(owner, call.args[0])
+                    if owner is not None else None)
+            if base is None and isinstance(call.args[0], ast.Name) \
+                    and owner is None:
+                pass
+            if base is not None:
+                hit = self.class_method(base, "receive_message")
+                if hit is not None:
+                    self._add_root(hit, "observer", call.lineno, False)
+
+    # ------------------------------------------------------------- scan
+    def _lock_key(self, fn: FunctionInfo,
+                  expr: ast.AST) -> Optional[LockKey]:
+        """The lock identity a with-item / acquire receiver names."""
+        if isinstance(expr, ast.Attribute):
+            base = self._receiver_class(fn, expr.value)
+            if base is not None:
+                table = self.lock_attrs.get(base, {})
+                if expr.attr in table:
+                    return ("A", base[0], base[1], table[expr.attr])
+                low = expr.attr.lower()
+                if any(t in low for t in _LOCKISH):
+                    return ("A", base[0], base[1], expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            mod = fn.module
+            if expr.id in self.module_locks.get(mod.modname, ()):
+                return ("G", mod.modname, expr.id)
+            for scope in fn.scope_chain():
+                bound = mod.scope_binds.get(
+                    mod.scope_id(scope), {}).get(expr.id)
+                if bound is not None:
+                    if isinstance(bound, ast.Attribute):
+                        return self._lock_key(fn, bound)
+                    if self._is_lock_ctor(mod, bound):
+                        return ("B", mod.modname, fn.qualname, expr.id)
+                    return None
+        return None
+
+    def _scan_function(self, fn: FunctionInfo):
+        mod = fn.module
+        in_init = False
+        f: Optional[FunctionInfo] = fn
+        while f is not None:
+            if f.name == "__init__":
+                in_init = True
+            f = f.parent
+        own_cls: Optional[ClassKey] = (
+            (mod.modname, fn.cls) if fn.cls else None)
+        edges = self._edges.setdefault(fn, set())
+        globals_declared: Set[str] = set()
+
+        def self_field(attr: str) -> Optional[FieldKey]:
+            if own_cls is None:
+                return None
+            if attr in self.lock_attrs.get(own_cls, {}):
+                return None
+            if self.class_method(own_cls, attr) is not None:
+                return None
+            return ("attr", own_cls[0], own_cls[1], attr)
+
+        def recv_field(base: ClassKey, attr: str) -> Optional[FieldKey]:
+            if attr in self.lock_attrs.get(base, {}):
+                return None
+            if self.class_method(base, attr) is not None:
+                return None
+            return ("attr", base[0], base[1], attr)
+
+        def record(field: Optional[FieldKey], write: bool, lineno: int,
+                   held: frozenset):
+            if field is not None:
+                self.accesses.append(
+                    _Access(field, write, lineno, fn, held, in_init))
+
+        def classify_store(tgt: ast.AST, held: frozenset):
+            """Record the write a store target represents; returns the
+            sub-expressions still needing a read walk (indexes etc.)."""
+            rest: List[ast.AST] = []
+            sub = isinstance(tgt, ast.Subscript)
+            base = tgt.value if sub else tgt
+            if isinstance(base, ast.Attribute):
+                if self._is_self_attr(base):
+                    record(self_field(base.attr), True, tgt.lineno, held)
+                else:
+                    rcls = self._receiver_class_of(fn, base.value, 0)
+                    if rcls is not None:
+                        record(recv_field(rcls, base.attr), True,
+                               tgt.lineno, held)
+                    rest.append(base.value)
+            elif isinstance(base, ast.Name):
+                if base.id in globals_declared or (
+                        sub and base.id in self.module_names.get(
+                            mod.modname, ())):
+                    record(("global", mod.modname, base.id), True,
+                           tgt.lineno, held)
+            else:
+                rest.append(base)
+            if sub:
+                rest.append(tgt.slice)
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    rest.extend(classify_store(el, held) or [])
+                    # classify_store records; keep direct recursion simple
+            return rest
+
+        def handle_call(node: ast.Call, held: frozenset) -> List[ast.AST]:
+            """Record blocking events / mutator writes / call edges.
+            Returns children still needing a generic walk."""
+            rest: List[ast.AST] = list(node.args) + [
+                k.value for k in node.keywords]
+            fnode = node.func
+            if isinstance(fnode, ast.Name):
+                hits = self.resolver.resolve(
+                    mod, fn.scope_chain(), fnode.id)
+                edges.update(hits)
+                if held and fnode.id == "send_message":
+                    self.blocking.append(_Blocking(
+                        node.lineno, fn, "send_message()", held))
+                return rest
+            if not isinstance(fnode, ast.Attribute):
+                rest.append(fnode)
+                return rest
+            attr = fnode.attr
+            recv = fnode.value
+            d = dotted_name(fnode)
+            real = resolve_dotted_head(mod, d) if d else None
+            # blocking calls under a held lock
+            if held:
+                if real == "time.sleep":
+                    self.blocking.append(
+                        _Blocking(node.lineno, fn, "time.sleep()", held))
+                elif attr in _BLOCKING_ATTRS and not (
+                        attr in ("join", "result") and node.args):
+                    lk = self._lock_key(fn, recv)
+                    if lk is None:
+                        self.blocking.append(_Blocking(
+                            node.lineno, fn, f".{attr}()", held))
+                elif attr in ("acquire", "wait"):
+                    lk = self._lock_key(fn, recv)
+                    if lk is not None and lk not in held:
+                        self.blocking.append(_Blocking(
+                            node.lineno, fn,
+                            f"{attr} of a different lock "
+                            f"({_lock_label(lk)})", held))
+            # self.helper(...) callsites (lock inheritance + closure)
+            if isinstance(recv, ast.Name) and recv.id == "self" \
+                    and own_cls is not None:
+                hit = self.class_method(own_cls, attr)
+                if hit is not None:
+                    edges.add(hit)
+                    self._self_callsites.setdefault(hit, []).append(
+                        (fn, held))
+                    return rest
+                # callable attribute invoked: a read of the binding
+                record(self_field(attr), False, fnode.lineno, held)
+                return rest
+            # self.attr.m(...): mutator = write to the shared structure,
+            # resolvable method = closure edge, anything else = read
+            if self._is_self_attr(recv):
+                if attr in _MUTATORS:
+                    record(self_field(recv.attr), True, node.lineno, held)
+                    return rest
+                rcls = self._receiver_class_of(fn, recv, 0)
+                if rcls is not None:
+                    hit = self.class_method(rcls, attr)
+                    if hit is not None:
+                        edges.add(hit)
+                        self._ext_called.add(hit)
+                        return rest
+                record(self_field(recv.attr), False, recv.lineno, held)
+                return rest
+            # mutator through a subscript of self.attr: sketches[lane].add
+            if attr in _MUTATORS and isinstance(recv, ast.Subscript) \
+                    and self._is_self_attr(recv.value):
+                record(self_field(recv.value.attr), True, node.lineno, held)
+                rest.append(recv.slice)
+                return rest
+            # typed-receiver method call: closure edge
+            rcls = self._receiver_class_of(fn, recv, 0)
+            if rcls is not None:
+                hit = self.class_method(rcls, attr)
+                if hit is not None:
+                    edges.add(hit)
+                    self._ext_called.add(hit)
+                    return rest
+                if attr in _MUTATORS and isinstance(recv, ast.Attribute):
+                    base2 = self._receiver_class_of(fn, recv.value, 0)
+                    if base2 is not None:
+                        record(recv_field(base2, recv.attr), True,
+                               node.lineno, held)
+                        return rest
+            rest.append(recv)
+            return rest
+
+        def visit(node: ast.AST, held: frozenset):
+            if isinstance(node, ScopeNode):
+                return  # nested defs are scanned as their own functions
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    lk = self._lock_key(fn, item.context_expr)
+                    if lk is not None:
+                        if held and lk not in held:
+                            self.blocking.append(_Blocking(
+                                item.context_expr.lineno, fn,
+                                f"acquire of a second lock "
+                                f"({_lock_label(lk)})", held))
+                        acquired.append(lk)
+                    else:
+                        visit(item.context_expr, held)
+                inner = held.union(acquired)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    for extra in classify_store(tgt, held):
+                        visit(extra, held)
+                if getattr(node, "value", None) is not None:
+                    visit(node.value, held)
+                return
+            if isinstance(node, ast.Call):
+                for child in handle_call(node, held):
+                    visit(child, held)
+                return
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                if self._is_self_attr(node):
+                    if own_cls is not None:
+                        hit = self.class_method(own_cls, node.attr)
+                        if hit is not None:
+                            edges.add(hit)
+                            return
+                    record(self_field(node.attr), False, node.lineno, held)
+                    return
+                rcls = self._receiver_class_of(fn, node.value, 0)
+                if rcls is not None:
+                    hit = self.class_method(rcls, node.attr)
+                    if hit is not None:
+                        edges.add(hit)
+                        self._ext_called.add(hit)
+                        return
+                    record(recv_field(rcls, node.attr), False,
+                           node.lineno, held)
+                    return
+                visit(node.value, held)
+                return
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                edges.update(self.resolver.resolve(
+                    mod, fn.scope_chain(), node.id))
+                if node.id in self.module_names.get(mod.modname, ()):
+                    record(("global", mod.modname, node.id), False,
+                           node.lineno, held)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        body = (fn.node.body if not isinstance(fn.node, ast.Lambda)
+                else [fn.node.body])
+        for stmt in body:
+            visit(stmt, frozenset())
+
+    # ------------------------------------------- helper lock inheritance
+    def _inherit_helper_locks(self):
+        inherited: Dict[FunctionInfo, frozenset] = {}
+        for fn, sites in self._self_callsites.items():
+            if not fn.name.startswith("_") or fn.name.startswith("__"):
+                continue
+            if fn in self.roots or fn in self._ext_called:
+                continue
+            helds = [held for caller, held in sites
+                     if caller.name != "__init__"]
+            if not helds:
+                continue
+            common = frozenset.intersection(*map(frozenset, helds))
+            if common:
+                inherited[fn] = common
+        if not inherited:
+            return
+        for a in self.accesses:
+            extra = inherited.get(a.fn)
+            if extra:
+                a.held = a.held | extra
+        for b in self.blocking:
+            extra = inherited.get(b.fn)
+            if extra:
+                b.held = b.held | extra
+
+    # ---------------------------------------------------------- closure
+    def _close_roots(self):
+        for root in self.roots:
+            seen: Set[FunctionInfo] = set()
+            work = [root]
+            while work:
+                f = work.pop()
+                if f in seen:
+                    continue
+                seen.add(f)
+                self.roots_reaching.setdefault(f, set()).add(root)
+                work.extend(self._edges.get(f, ()))
+
+    # --------------------------------------------------------- findings
+    def _root_weight(self, roots: Set[FunctionInfo]) -> int:
+        return sum(2 if self.roots[r].multi else 1 for r in roots)
+
+    def _build_findings(self):
+        by_field: Dict[FieldKey, List[_Access]] = {}
+        for a in self.accesses:
+            if not a.in_init:
+                by_field.setdefault(a.field, []).append(a)
+
+        unguarded_w: List[Finding] = []
+        check_act: List[Finding] = []
+        for field, accs in by_field.items():
+            roots: Set[FunctionInfo] = set()
+            any_write = False
+            main_side = False
+            for a in accs:
+                rr = self.roots_reaching.get(a.fn)
+                if rr:
+                    roots |= rr
+                else:
+                    main_side = True  # touched outside every root closure
+                if a.write:
+                    any_write = True
+            # shared = accesses span two concurrent parties. The main
+            # thread counts as one party when it touches the field after
+            # construction (__init__ accesses were already excluded):
+            # root-vs-main races (profiler snapshot vs. handler growth)
+            # are as real as root-vs-root ones.
+            weight = self._root_weight(roots) + (1 if main_side else 0)
+            if not roots or not any_write or weight < 2:
+                continue
+            counts: Dict[LockKey, int] = {}
+            for a in accs:
+                for lk in a.held:
+                    counts[lk] = counts.get(lk, 0) + 1
+            if not counts:
+                continue
+            guard = max(counts, key=lambda k: (counts[k], k))
+            locked_n = counts[guard]
+            bare = [a for a in accs if guard not in a.held]
+            if locked_n < 2 or locked_n < len(bare):
+                continue
+            total = len(accs)
+            fname = _field_label(field)
+            lname = _lock_label(guard)
+            rlabel = ", ".join(sorted(
+                self.roots[r].label() for r in roots)[:3])
+            for a in bare:
+                if a.write:
+                    unguarded_w.append(Finding(
+                        "unguarded-shared-write", a.fn.module.relpath,
+                        a.lineno,
+                        f"write to shared field '{fname}' outside its "
+                        f"guarding lock '{lname}' ({locked_n}/{total} "
+                        f"accesses hold it; concurrent roots: {rlabel})",
+                    ))
+                else:
+                    check_act.append(Finding(
+                        "check-then-act", a.fn.module.relpath, a.lineno,
+                        f"read of '{fname}' outside its guarding lock "
+                        f"'{lname}' — the value can change before it is "
+                        f"used ({locked_n}/{total} accesses hold the lock; "
+                        f"concurrent roots: {rlabel})",
+                    ))
+
+        blocking: List[Finding] = []
+        for b in self.blocking:
+            lname = ", ".join(sorted(_lock_label(k) for k in b.held))
+            blocking.append(Finding(
+                "blocking-under-lock", b.fn.module.relpath, b.lineno,
+                f"{b.what} while holding '{lname}' in '{b.fn.qualname}' — "
+                "a blocked holder stalls every thread contending the lock",
+            ))
+        self._findings = {
+            "unguarded-shared-write": unguarded_w,
+            "check-then-act": check_act,
+            "blocking-under-lock": blocking,
+        }
+
+    def findings(self, rule: str) -> List[Finding]:
+        if self._findings is None:
+            self._build_findings()
+        return list(self._findings[rule])
+
+
+def _field_label(field: FieldKey) -> str:
+    if field[0] == "attr":
+        return f"{field[2]}.{field[3]}"
+    return f"{field[1]}:{field[2]}"
+
+
+def _lock_label(lk: LockKey) -> str:
+    if lk[0] == "A":
+        return f"{lk[2]}.{lk[3]}"
+    if lk[0] == "G":
+        return f"{lk[1]}:{lk[2]}"
+    return f"{lk[2]}:{lk[3]}"
+
+
+#: identity-keyed model cache: the engine runs three checkers against ONE
+#: PackageIndex — build the model once, not per rule
+_CACHE: List[tuple] = []
+
+
+def model_for(pkg: PackageIndex) -> ThreadModel:
+    for cached_pkg, model in _CACHE:
+        if cached_pkg is pkg:
+            return model
+    model = ThreadModel(pkg)
+    _CACHE.append((pkg, model))
+    del _CACHE[:-4]
+    return model

@@ -254,7 +254,13 @@ class ChaosCommManager(BaseCommunicationManager, Observer):
 
     # -- receive path ------------------------------------------------------
     def receive_message(self, msg_type, msg: Message) -> None:
-        if self._crashed:
+        # capture under the lock (the restart timer flips the flag from
+        # its own thread), then dispatch OUTSIDE it — _notify fans out to
+        # handlers that may send, and sending under _lock would stall the
+        # crash/restart timers against the delivery path
+        with self._lock:
+            crashed = self._crashed
+        if crashed:
             return
         self._notify(msg)
 
@@ -265,7 +271,8 @@ class ChaosCommManager(BaseCommunicationManager, Observer):
     def stop_receive_message(self) -> None:
         with self._lock:
             held, self._held = self._held, None
-        if held is not None and not self._crashed:
+            crashed = self._crashed
+        if held is not None and not crashed:
             # a reorder hold with no follow-up send would turn reorder into
             # silent drop at shutdown; flush it instead
             try:

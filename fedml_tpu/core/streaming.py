@@ -126,11 +126,14 @@ class StreamAccumulator:
         have folded."""
         import jax
 
-        total = 0
-        if self._acc is not None:
-            total += sum(np.asarray(leaf).nbytes
-                         for leaf in jax.tree.leaves(self._acc))
-        for t, _w in self._held.values():
-            total += sum(np.asarray(leaf).nbytes
-                         for leaf in jax.tree.leaves(t))
-        return int(total)
+        # locked: add() on the arrival path mutates _held mid-iteration
+        # otherwise (dict-changed-size) and swaps _acc leaves mid-sum
+        with self._lock:
+            total = 0
+            if self._acc is not None:
+                total += sum(np.asarray(leaf).nbytes
+                             for leaf in jax.tree.leaves(self._acc))
+            for t, _w in self._held.values():
+                total += sum(np.asarray(leaf).nbytes
+                             for leaf in jax.tree.leaves(t))
+            return int(total)

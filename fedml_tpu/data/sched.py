@@ -206,8 +206,11 @@ class CohortScheduler:
     @property
     def wants_notify(self) -> bool:
         """Whether the consumer should call :meth:`notify_round_done` —
-        only the live-fed profiler policies need boundary snapshots."""
-        return self.policy != "uniform" and self._static is None
+        only the live-fed profiler policies need boundary snapshots.
+        Locked: set_static_profile can freeze the signal from another
+        thread mid-run, and the check must see a settled _static."""
+        with self._lock:
+            return self.policy != "uniform" and self._static is None
 
     def set_static_profile(self, source) -> None:
         """Freeze the scheduling signal: ``source`` is a ProfileSnapshot or

@@ -140,6 +140,10 @@ class FedBuffAggregator:
 
     @property
     def uploads_folded(self) -> int:
+        # the dispatch thread is the buffer's only mutator (handlers
+        # serialize on the manager loop; timers re-enter via inject_local,
+        # see _arm_probe) — a lock-free stat read cannot tear
+        # fedlint: disable=check-then-act
         return self.buffer.folds
 
     @property
@@ -284,6 +288,10 @@ class FedBuffEdgeServerManager(ServerManager):
         if resend and cached is not None and cached[0] == int(tag):
             _tag, version, params = cached
         else:
+            # version only moves in emit(), on this same dispatch thread
+            # (handlers serialize on the manager loop; timers re-enter via
+            # inject_local) — the pair read here cannot straddle an emit
+            # fedlint: disable=check-then-act
             version, params = self.buffer.version, self.aggregator.variables
         ids = self._assignment(worker, tag)
         m = Message(msg_type, self.rank, worker + 1)
@@ -350,6 +358,9 @@ class FedBuffEdgeServerManager(ServerManager):
             sent = self._sent_at.get(worker)
             pulse.observe_upload(
                 self._assignment_map.get(worker) or [],
+                # dispatch-thread-only read; emit() is the sole writer and
+                # runs on this same thread (see _send_assignment above)
+                # fedlint: disable=check-then-act
                 self.buffer.version,
                 train_ms=(None if sent is None
                           else (time.perf_counter() - sent) * 1e3),
@@ -402,6 +413,9 @@ class FedBuffEdgeServerManager(ServerManager):
                 loss=(float(metrics["loss"]) if metrics
                       and metrics.get("loss") is not None else None),
                 round_ms=(time.perf_counter() - self._emit_t0) * 1e3,
+                # dispatch-thread-only read; emit() is the sole writer and
+                # runs on this same thread (see _send_assignment above)
+                # fedlint: disable=check-then-act
                 extra={"server_version": self.buffer.version,
                        "uploads": rec["folds"],
                        "version_lag_max": rec["staleness_max"],

@@ -200,7 +200,15 @@ class ClientProfiler:
     def nbytes(self) -> int:
         """Measured store footprint (the bound the tests pin) — the flat
         per-client arrays PLUS the sketch lanes' sparse stores (each
-        structurally capped at its bucket-universe size)."""
+        structurally capped at its bucket-universe size). Locked: `observe`
+        on the handler thread swaps the arrays when `_ensure` doubles
+        capacity, and half-grown reads would double-count."""
+        with self._lock:
+            return self._nbytes_locked()
+
+    def _nbytes_locked(self) -> int:
+        # callers hold self._lock (aggregates() sums this inside its
+        # snapshot section; taking the plain Lock again would deadlock)
         return int(self._ema_train_ms.nbytes + self._upload_bytes.nbytes
                    + self._participation.nbytes + self._last_seen.nbytes
                    + sum(sk.nbytes for sk in self.sketches.values()))
@@ -240,7 +248,11 @@ class ClientProfiler:
 
     @property
     def clients_seen(self) -> int:
-        return int((self._participation[: self._n] > 0).sum())
+        # locked: _ensure's growth swaps _participation for a larger array
+        # while observe holds the lock; pairing the stale array with the
+        # new _n would scan garbage tail entries
+        with self._lock:
+            return int((self._participation[: self._n] > 0).sum())
 
     def _seen_ids(self) -> np.ndarray:
         return np.nonzero(self._participation[: self._n] > 0)[0]
@@ -264,7 +276,11 @@ class ClientProfiler:
         with self._lock:
             ids = self._seen_ids()
             last = self._last_seen[ids]
-        base = self.last_round if round_idx is None else int(round_idx)
+            # capture under the lock: observe() bumps last_round on the
+            # handler thread, and a post-release read could pair a newer
+            # base with the older ids/last snapshot (negative staleness)
+            newest = self.last_round
+        base = newest if round_idx is None else int(round_idx)
         return np.stack([ids, base - last.astype(np.int64)])
 
     def participation_fairness(self) -> dict:
@@ -296,7 +312,7 @@ class ClientProfiler:
             part = self._participation[:n]
             seen = part > 0
             ns = int(seen.sum())
-            out = {"clients_seen": ns, "store_bytes": self.nbytes,
+            out = {"clients_seen": ns, "store_bytes": self._nbytes_locked(),
                    "dropped_ids": int(self.dropped)}
             if ns == 0:
                 return out
@@ -305,6 +321,7 @@ class ClientProfiler:
             last = self._last_seen[ids]
             upload = float(self._upload_bytes[:n].sum())
             pseen = part[ids]
+            newest = self.last_round
         out["participation"] = {
             "mean": round(float(pseen.mean()), 3), "max": int(pseen.max()),
             "gini": round(_gini(pseen), 4)}
@@ -319,7 +336,9 @@ class ClientProfiler:
             out["stragglers"] = [
                 {"client": int(ids[j]), "ema_ms": round(float(ema[j]), 3),
                  "rounds": int(pseen[j])} for j in order]
-        base = self.last_round if round_idx is None else int(round_idx)
+        # `newest` was captured inside the lock with ids/last: a fresher
+        # last_round paired with the older snapshot would skew staleness
+        base = newest if round_idx is None else int(round_idx)
         st = base - last.astype(np.int64)
         out["staleness"] = {"mean": round(float(st.mean()), 3),
                             "max": int(st.max())}

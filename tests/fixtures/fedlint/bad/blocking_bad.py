@@ -1,0 +1,31 @@
+"""Known-bad fedrace fixture: every blocking-under-lock shape — sleep,
+queue put, send_message, and acquiring a second lock while holding one."""
+
+import threading
+import time
+
+
+class Pump:
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self.q = q
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self._tick, daemon=True).start()
+
+    def _tick(self):
+        with self._lock:
+            time.sleep(0.01)
+            self.q.put(1)
+        with self._lock:
+            with self._aux:
+                self.n += 1
+
+    def send_message(self, m):
+        return m
+
+    def flush(self, m):
+        with self._lock:
+            self.send_message(m)

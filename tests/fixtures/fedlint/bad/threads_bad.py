@@ -1,0 +1,39 @@
+"""Known-bad fedrace fixture: unguarded-shared-write + check-then-act,
+with one thread rooted through functools.partial and one bad-suppression
+(unknown rule name) that must NOT silence anything."""
+
+import threading
+from functools import partial
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = []
+        self.pending = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+        for _ in range(2):
+            # root-via-partial: the analyzer must unwrap partial(self._drain)
+            threading.Thread(target=partial(self._drain, True),
+                             daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self.pending += 1
+        with self._lock:
+            self.pending += 1
+        self.pending += 1
+
+    def _drain(self, always):
+        if len(self._inbox) > 0:
+            with self._lock:
+                self._inbox.pop()
+        with self._lock:
+            self._inbox.append(always)
+
+    # fedlint: disable=unguarded-shared-writ
+    def poke(self):
+        with self._lock:
+            self.pending += 1

@@ -1,0 +1,23 @@
+"""Clean twin of blocking_bad: capture under the lock, block outside it."""
+
+import threading
+import time
+
+
+class Pump:
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self.q = q
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self._tick, daemon=True).start()
+
+    def _tick(self):
+        with self._lock:
+            item = self.n
+            self.n += 1
+        self.q.put(item)
+        time.sleep(0.01)
+        with self._lock:
+            self.n += 1

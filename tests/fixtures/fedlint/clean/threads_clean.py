@@ -1,0 +1,35 @@
+"""Clean twin of threads_bad: every shared access holds the inferred
+guard; waiting on a Condition built over the SAME lock is not a
+foreign-lock acquisition; Timer and partial roots resolve identically."""
+
+import threading
+from functools import partial
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._inbox = []
+        self.pending = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+        for _ in range(2):
+            threading.Thread(target=partial(self._drain, True),
+                             daemon=True).start()
+        t = threading.Timer(0.01, self._loop)
+        t.daemon = True
+        t.start()
+
+    def _loop(self):
+        with self._cv:
+            self.pending += 1
+            self._cv.notify()
+
+    def _drain(self, always):
+        with self._cv:
+            while not self._inbox:
+                self._cv.wait(0.01)
+            self._inbox.pop()
+            self._inbox.append(always)

@@ -31,10 +31,12 @@ def _by_file(findings):
 
 
 def test_rule_catalog_complete():
-    # the six shipped rules + the suppression-integrity meta rule
+    # six shipped rules + the three fedrace concurrency rules + the
+    # suppression-integrity meta rule
     assert set(RULES) == {
         "traced-purity", "retrace-hazard", "seeded-rng",
         "protocol-exhaustiveness", "config-flag-drift", "trace-coverage",
+        "unguarded-shared-write", "check-then-act", "blocking-under-lock",
         "bad-suppression",
     }
 
@@ -68,6 +70,18 @@ def test_bad_corpus_exact_rule_ids_and_lines():
         ],
         "trace_bad.py": [
             ("trace-coverage", 5),   # run_round override bypasses the wrapper
+        ],
+        "threads_bad.py": [
+            # the typo'd rule name is an error AND silences nothing
+            ("bad-suppression", 36),
+            ("check-then-act", 30),          # len-check outside the lock
+            ("unguarded-shared-write", 27),  # bare write off the _loop root
+        ],
+        "blocking_bad.py": [
+            ("blocking-under-lock", 20),  # time.sleep under _lock
+            ("blocking-under-lock", 21),  # Queue.put under _lock
+            ("blocking-under-lock", 23),  # second lock (_aux) under _lock
+            ("blocking-under-lock", 31),  # send_message under _lock
         ],
     }
 
@@ -130,6 +144,7 @@ def test_cli_json_exit_codes_and_payload():
     assert {f["rule"] for f in payload["findings"]} == {
         "traced-purity", "retrace-hazard", "seeded-rng",
         "protocol-exhaustiveness", "config-flag-drift", "trace-coverage",
+        "unguarded-shared-write", "check-then-act", "blocking-under-lock",
         "bad-suppression",
     }
     clean = _run_cli(CLEAN, "--format", "json")

@@ -1,0 +1,316 @@
+"""fedrace — the static thread model (analysis/threads.py), the partial-
+unwrapping callgraph fix, and dynamic regression witnesses for the
+concurrency fixes the analyzer forced in the runtime tree.
+
+The stress test at the bottom is the dynamic counterpart of the static
+rules: eight barrier-released threads hammer the exact structures the
+analyzer reasons about (BoundedInbox under its condition lock,
+CounterGroup under its documented lock-free distinct-key contract) and
+assert EXACT counts — a torn update shows up as an off-by-N, not a flake.
+"""
+
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.analysis import run_lint
+from fedml_tpu.analysis.callgraph import TracedGraph
+from fedml_tpu.analysis.index import load_package
+from fedml_tpu.analysis.threads import ThreadModel
+
+
+def _pkg(tmp_path, src):
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "mod.py").write_text(textwrap.dedent(src), encoding="utf-8")
+    return root
+
+
+# -- thread-root inference ---------------------------------------------------
+
+def test_thread_roots_across_spawn_paradigms(tmp_path):
+    model = ThreadModel(load_package(str(_pkg(tmp_path, """\
+        import atexit
+        import threading
+        from functools import partial
+
+
+        def _flush():
+            pass
+
+
+        atexit.register(_flush)
+
+
+        class Node:
+            def __init__(self, comm, pool):
+                comm.register_message_receive_handler(3, self._on_msg)
+                self.on_restart = self._revive
+                pool.submit(self._work, 1)
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+                threading.Timer(1.0, partial(self._sweep, True)).start()
+
+            def _loop(self):
+                pass
+
+            def _sweep(self, flag):
+                pass
+
+            def _work(self, n):
+                pass
+
+            def _on_msg(self, t, m):
+                pass
+
+            def _revive(self):
+                pass
+        """))))
+    kinds = {r.fn.name: r.kind for r in model.roots.values()}
+    assert kinds == {
+        "_flush": "atexit",
+        "_loop": "thread",
+        "_sweep": "timer",       # rooted THROUGH functools.partial
+        "_work": "executor",
+        "_on_msg": "handler",
+        "_revive": "callback",   # on_* hook assignment
+    }
+    multi = {r.fn.name: r.multi for r in model.roots.values()}
+    assert multi["_work"] is True     # executor targets self-overlap
+    assert multi["_loop"] is False    # spawned exactly once
+
+
+def test_partial_root_in_loop_is_multi_and_flags_bare_write(tmp_path):
+    root = _pkg(tmp_path, """\
+        import threading
+        from functools import partial
+
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def start(self):
+                for _ in range(4):
+                    threading.Thread(target=partial(self._bump, 1)).start()
+
+            def _bump(self, k):
+                with self._lock:
+                    self.n += k
+                with self._lock:
+                    self.n += k
+                self.n += k
+        """)
+    model = ThreadModel(load_package(str(root)))
+    (r,) = list(model.roots.values())
+    assert (r.fn.name, r.kind, r.multi) == ("_bump", "thread", True)
+    res = run_lint(str(root), rules=["unguarded-shared-write"])
+    assert [(f.rule, f.line) for f in res.findings] == [
+        ("unguarded-shared-write", 19)
+    ], [f.format() for f in res.findings]
+
+
+def test_traced_graph_unwraps_partial_and_bound_method(tmp_path):
+    pkg = load_package(str(_pkg(tmp_path, """\
+        from functools import partial
+
+        from jax import jit
+
+
+        def step(x, k):
+            return x + k
+
+
+        fast = jit(partial(step, 3))
+
+
+        class Model:
+            def _inner(self, x):
+                return x
+
+            def build(self):
+                return jit(partial(self._inner))
+        """)))
+    assert {fn.name for fn in TracedGraph(pkg).roots} == {"step", "_inner"}
+
+
+# -- regression witnesses for the fixed findings -----------------------------
+
+def test_profiler_snapshot_readers_safe_under_concurrent_growth():
+    """fedrace fix witness (obs/profile.py): nbytes/clients_seen/staleness/
+    aggregates must pair a consistent (arrays, _n, last_round) snapshot
+    while observe() grows the store 16 -> 2560 across reallocations."""
+    from fedml_tpu.obs.profile import ClientProfiler
+
+    prof = ClientProfiler(capacity_hint=16)
+    done = threading.Event()
+    errs = []
+
+    def writer():
+        try:
+            for r in range(40):
+                ids = np.arange(r * 64, (r + 1) * 64)
+                prof.observe(ids, r, train_ms=np.ones(64))
+        except Exception as e:  # pragma: no cover - the regression signal
+            errs.append(e)
+        finally:
+            done.set()
+
+    def reader():
+        try:
+            while not done.is_set():
+                prof.nbytes
+                prof.clients_seen
+                stal = prof.staleness()
+                assert (stal[1] >= 0).all(), "negative staleness: torn base"
+                prof.aggregates()
+        except Exception as e:  # pragma: no cover - the regression signal
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    assert prof.clients_seen == 40 * 64
+    assert prof.staleness().shape[1] == 40 * 64
+
+
+def test_stream_accumulator_nbytes_safe_during_held_growth():
+    """fedrace fix witness (core/streaming.py): nbytes sums the held trees
+    while add() mutates the dict from another thread — unlocked, this dies
+    with 'dictionary changed size during iteration'."""
+    from fedml_tpu.core.streaming import StreamAccumulator
+
+    acc = StreamAccumulator("deterministic")
+    tree = {"a": np.ones((32, 32), np.float32), "b": np.ones(7, np.float32)}
+    done = threading.Event()
+    errs = []
+
+    def writer():
+        try:
+            # reverse order: every add parks in _held (peak records index
+            # 0's insertion before the flush loop pops), so the dict grows
+            # to all 200 entries before draining
+            for i in range(199, -1, -1):
+                acc.add(i, tree, 1.0)
+        except Exception as e:  # pragma: no cover - the regression signal
+            errs.append(e)
+        finally:
+            done.set()
+
+    def reader():
+        try:
+            while not done.is_set():
+                acc.nbytes
+        except Exception as e:  # pragma: no cover - the regression signal
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer), threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    assert acc.peak_held == 200
+
+
+# -- interleaving stress -----------------------------------------------------
+
+@pytest.mark.chaos
+def test_eight_thread_inbox_and_counter_stress():
+    """Barrier-released interleaving hammer over BoundedInbox and
+    CounterGroup. Phase 1 pins the backpressure contract exactly: with
+    producers using try_put only, ``peak <= cap`` and every accepted
+    message is consumed FIFO per sender. Phase 2 pins conservation when
+    put_control (cap bypass) and shed_older_than contend: each prefilled
+    stale message is shed exactly once."""
+    from fedml_tpu.comm.flow import BoundedInbox
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.obs.registry import CounterGroup
+
+    THREADS, PER, CAP = 8, 120, 8
+    errs = []
+
+    # -- phase 1: try_put vs take under a full queue -------------------------
+    inbox = BoundedInbox(cap=CAP)
+    counters = CounterGroup("fedrace_stress",
+                            keys=[f"t{i}" for i in range(THREADS)])
+    barrier = threading.Barrier(THREADS + 1)
+    consumed = []
+
+    def producer(t):
+        try:
+            barrier.wait()
+            for i in range(PER):
+                m = Message(1, sender_id=t, receiver_id=0)
+                m.add_params("round_idx", i)
+                while not inbox.try_put(m):
+                    time.sleep(0)  # full: yield until the consumer drains
+                counters[f"t{t}"] += 1  # distinct key per thread (contract)
+        except Exception as e:  # pragma: no cover - the regression signal
+            errs.append(e)
+
+    def consumer():
+        try:
+            barrier.wait()
+            for _ in range(THREADS * PER):
+                consumed.append(inbox.take())
+        except Exception as e:  # pragma: no cover - the regression signal
+            errs.append(e)
+
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(THREADS)] + [threading.Thread(target=consumer)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    assert len(consumed) == THREADS * PER
+    assert inbox.depth() == 0
+    assert inbox.peak <= CAP, f"backpressure breached: {inbox.peak} > {CAP}"
+    assert dict(counters) == {f"t{i}": PER for i in range(THREADS)}
+    per_sender = {}
+    for m in consumed:
+        per_sender.setdefault(m.get_sender_id(), []).append(
+            m.get("round_idx"))
+    assert per_sender == {t: list(range(PER)) for t in range(THREADS)}
+
+    # -- phase 2: put_control + shed_older_than contention -------------------
+    inbox2 = BoundedInbox(cap=4)
+    for _ in range(4):
+        stale = Message(1, sender_id=99, receiver_id=0)
+        stale.add_params("round_idx", 0)
+        assert inbox2.try_put(stale)
+    shed = CounterGroup("fedrace_stress2",
+                        keys=[f"t{i}" for i in range(THREADS)])
+    b2 = threading.Barrier(THREADS)
+
+    def churn(t):
+        try:
+            b2.wait()
+            for i in range(20):
+                if inbox2.shed_older_than(100) is not None:
+                    shed[f"t{t}"] += 1
+                inbox2.put_control(("ctl", t, i))
+        except Exception as e:  # pragma: no cover - the regression signal
+            errs.append(e)
+
+    threads = [threading.Thread(target=churn, args=(t,))
+               for t in range(THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    assert sum(dict(shed).values()) == 4  # each stale message shed ONCE
+    assert inbox2.depth() == THREADS * 20  # only control sentinels remain
+    assert inbox2.drain() == []  # drain returns Messages; sentinels aren't
+    assert inbox2.depth() == 0

@@ -13,9 +13,10 @@ out next to the dot count, so each PR can see its budget profile:
 
 Report: DOTS (passed-in-window, the gate's own regex), outcome summary
 line, failure/error names, the slowest-10 test files, the compile-cache
-line, and the obs-overhead line (the pinned full-plane-on vs off wall
-delta from the fedsketch budget test). ``--json`` emits the same as one
-JSON object.
+line, the obs-overhead line (the pinned full-plane-on vs off wall
+delta from the fedsketch budget test), and the fedlint line (rule count
+plus unsuppressed/suppressed finding counts over the real tree).
+``--json`` emits the same as one JSON object.
 
 Exit codes: 0 parsed; 2 when the file has no pytest progress output at all
 (wrong file / empty log).
@@ -44,6 +45,7 @@ FAIL_RE = re.compile(r"^(FAILED|ERROR) (\S+)")
 FILE_SECONDS_RE = re.compile(r"^\[t1\] file-seconds: (\[.*\])\s*$")
 CACHE_RE = re.compile(r"^\[t1\] compile-cache: (.*)$")
 OBS_OVERHEAD_RE = re.compile(r"^\[t1\] obs-overhead: (.*)$")
+FEDLINT_RE = re.compile(r"^\[t1\] fedlint: (.*)$")
 
 
 def parse_log(text: str) -> dict:
@@ -54,6 +56,7 @@ def parse_log(text: str) -> dict:
     file_seconds: list = []
     cache_line = None
     obs_overhead = None
+    fedlint = None
     for line in text.splitlines():
         line = line.rstrip()
         if DOTS_RE.match(line):
@@ -81,6 +84,10 @@ def parse_log(text: str) -> dict:
         m = OBS_OVERHEAD_RE.match(line)
         if m:
             obs_overhead = m.group(1)
+            continue
+        m = FEDLINT_RE.match(line)
+        if m:
+            fedlint = m.group(1)
     return {
         "dots": dots,
         "dots_baseline": BASELINE_DOTS,
@@ -92,6 +99,7 @@ def parse_log(text: str) -> dict:
         "slowest_files": file_seconds[:10],
         "compile_cache": cache_line,
         "obs_overhead": obs_overhead,
+        "fedlint": fedlint,
     }
 
 
@@ -111,6 +119,8 @@ def format_report(rep: dict) -> str:
         lines.append(f"compile-cache: {rep['compile_cache']}")
     if rep.get("obs_overhead"):
         lines.append(f"obs-overhead: {rep['obs_overhead']}")
+    if rep.get("fedlint"):
+        lines.append(f"fedlint: {rep['fedlint']}")
     if rep["slowest_files"]:
         lines.append("slowest files (wall seconds in this session):")
         for path, secs in rep["slowest_files"]:
