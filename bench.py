@@ -237,6 +237,27 @@ def _bench_packed_conv_ab(ds, base_cfg, model: str, rounds: int, peak):
 
     out = dict({"mode": mode}, **measure_arms(FedAvgAPI, biggest_table))
 
+    # fedplan (ISSUE 18): when the measured arm is `auto`, embed the plan
+    # the run resolved — per-stage picks, predicted vs uniform ceilings —
+    # so the artifact records WHY the arm lowered the way it did
+    # (bench_report's `plan` column reads the summary string back)
+    if mode == "auto":
+        from fedml_tpu.parallel.packed import (packed_fallback_reason,
+                                               resolve_packed_conv)
+
+        bundle = create_model(model, 10, dtype=jnp.bfloat16,
+                              input_shape=ds.train_x.shape[2:],
+                              bn_impl=os.environ.get("BENCH_BN", "xla"),
+                              conv_impl=os.environ.get("BENCH_CONV", "xla"))
+        resolved = resolve_packed_conv(
+            "auto", bundle, int(base_cfg.pack_lanes),
+            optimizer=base_cfg.client_optimizer)
+        out["plan"] = (
+            {"resolved": resolved,
+             "reason": packed_fallback_reason(bundle, "auto",
+                                              base_cfg.client_optimizer)}
+            if isinstance(resolved, str) else resolved.to_dict())
+
     # packed-everywhere (ISSUE 12): one ADAPTIVE arm through the identical
     # harness — FedOpt with a stateful server optimizer rides the same
     # packed round program (hooks + threaded server state), so its

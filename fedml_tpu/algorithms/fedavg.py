@@ -570,17 +570,25 @@ class FedAvgAPI:
 
     def build_round_step_packed(self, shape_key: tuple):
         from fedml_tpu.parallel.crosssilo import apply_server_and_rollback
-        from fedml_tpu.parallel.packed import (make_packed_cohort_train,
-                                               packed_conv_active)
+        from fedml_tpu.parallel.packed import (impl_label,
+                                               make_packed_cohort_train,
+                                               packed_conv_active,
+                                               resolve_packed_conv)
 
         c = self.config
         n_pad = int(self.dataset.train_x.shape[1])
         hooks = self._packing_hooks() or {}
         server_update = hooks.get("server_update")
         has_extras = hooks.get("reduce_extras") is not None
+        # fedplan: 'auto' resolves HERE, at program-build time, against the
+        # schedule's actual lane count — the plan (or a concrete flag)
+        # flows to the builder and rides the cost hints below
+        pconv = resolve_packed_conv(c.packed_conv, self.bundle,
+                                    int(shape_key[0]),
+                                    optimizer=c.client_optimizer)
         packed = make_packed_cohort_train(
             self.bundle, self.task, n_pad, shape_key,
-            packed_conv=c.packed_conv,
+            packed_conv=pconv,
             client_transform=hooks.get("client_transform"),
             reduce_extras=hooks.get("reduce_extras"),
             **self._local_train_kwargs())
@@ -605,11 +613,14 @@ class FedAvgAPI:
         # form's block-diag dots stream n_lanes x the useful FLOPs; the
         # per-lane vmap form's grouped convs fold the same n_lanes clients
         # (H4) — either way the program folds shape_key[0] clients per op
-        active = packed_conv_active(self.bundle, c.packed_conv,
-                                    c.client_optimizer)
+        active = packed_conv_active(self.bundle, pconv, c.client_optimizer)
         round_step.cost_hints = {
-            "packed_conv": c.packed_conv if active else "off",
+            "packed_conv": impl_label(pconv) if active else "off",
             "packing_factor": int(shape_key[0])}
+        if active and not isinstance(pconv, str):
+            # the LoweringPlan itself: attribute_program self-checks the
+            # realized static ceiling against it and emits program_plan
+            round_step.cost_hints["plan"] = pconv
         return round_step
 
     def _run_packed_round(self, sampled, live, rk):
@@ -1064,13 +1075,17 @@ class FedAvgAPI:
         and the lane program's native weighted sums fold into the
         accumulator — the MXU fast path bounded by the accumulator, not by
         one program's cohort buffers."""
-        from fedml_tpu.parallel.packed import make_packed_cohort_train
+        from fedml_tpu.parallel.packed import (make_packed_cohort_train,
+                                               resolve_packed_conv)
 
         c = self.config
         n_pad = int(self.dataset.train_x.shape[1])
+        pconv = resolve_packed_conv(c.packed_conv, self.bundle,
+                                    int(shape_key[0]),
+                                    optimizer=c.client_optimizer)
         packed = make_packed_cohort_train(
             self.bundle, self.task, n_pad, shape_key,
-            packed_conv=c.packed_conv, key_slice=(cohort, start),
+            packed_conv=pconv, key_slice=(cohort, start),
             **self._local_train_kwargs())
         rows = jnp.arange(size, dtype=jnp.int32)
 
@@ -1607,9 +1622,11 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         (parallel/packed.py): per-device lanes, one psum tail. Returns None
         when packing doesn't apply (falls back to grouped/sharded)."""
         from fedml_tpu.parallel.packed import (
+            impl_label,
             make_crosssilo_packed_round,
             packed_conv_active,
             plan_packing_mesh,
+            resolve_packed_conv,
         )
 
         c, ds = self.config, self.dataset
@@ -1653,18 +1670,26 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         # fedscope compile telemetry: the packed mesh program is the most
         # expensive build in the tree (shard_map over vmapped lanes); its
         # shape key is the lane geometry that determines the XLA program
+        # fedplan: resolve 'auto' against the PER-DEVICE lane count — the
+        # contraction each device runs folds plan.n_lanes // D clients
+        pconv = resolve_packed_conv(c.packed_conv, self.bundle,
+                                    int(plan.n_lanes // D),
+                                    optimizer=c.client_optimizer)
+
         def _build():
             rf = make_crosssilo_packed_round(
                 self.bundle, self.task, n_pad, self.mesh,
-                packed_conv=c.packed_conv, **hooks,
+                packed_conv=pconv, **hooks,
                 **self._local_train_kwargs())
             # fedcost packing hint: the per-DEVICE contraction folds
             # lanes_dev clients (obs/cost.attribute_program)
-            active = packed_conv_active(self.bundle, c.packed_conv,
+            active = packed_conv_active(self.bundle, pconv,
                                         c.client_optimizer)
             rf.cost_hints = {
-                "packed_conv": c.packed_conv if active else "off",
+                "packed_conv": impl_label(pconv) if active else "off",
                 "packing_factor": int(plan.n_lanes // D)}
+            if active and not isinstance(pconv, str):
+                rf.cost_hints["plan"] = pconv
             return rf
 
         round_fn = timed_build(

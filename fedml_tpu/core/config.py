@@ -206,7 +206,12 @@ class FedConfig:
     # K*Cout, reduction lanes K*kh*kw*Cin — full MXU dims at K*C >= 128, at
     # the price of K x streamed FLOPs, reported honestly by fedcost's
     # packing_factor column); "grouped" runs one feature_group_count=K
-    # convolution (useful FLOPs only; XLA picks the MXU mapping). Applies
+    # convolution (useful FLOPs only; XLA picks the MXU mapping); "auto"
+    # asks the fedplan cost model (obs/plan.py) to pick PER CONV STAGE from
+    # the static fedcost table at program-build time — the chosen plan
+    # rides cost_hints, a program_plan trace instant and the "plan" pulse
+    # lane, and a post-first-call self-check warns when the realized
+    # static ceiling diverges from the prediction. Applies
     # wherever pack_lanes schedules lanes (sim + cross-silo mesh). The
     # joint form is the DEFAULT abstraction (packed-everywhere, DESIGN.md
     # §15): every client optimizer (stacked per-lane optax state),
@@ -411,9 +416,9 @@ class FedConfig:
             raise ValueError(f"bucket_groups must be >= 1, got {self.bucket_groups}")
         if self.pack_lanes < 0:
             raise ValueError(f"pack_lanes must be >= 0, got {self.pack_lanes}")
-        if self.packed_conv not in ("off", "blockdiag", "grouped"):
+        if self.packed_conv not in ("off", "blockdiag", "grouped", "auto"):
             raise ValueError(
-                f"packed_conv must be off|blockdiag|grouped, got "
+                f"packed_conv must be off|blockdiag|grouped|auto, got "
                 f"{self.packed_conv!r}")
         if self.cohort_policy not in ("uniform", "speed", "fair"):
             raise ValueError(
@@ -654,10 +659,12 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
     p.add_argument("--pack_lanes", type=int, default=defaults.pack_lanes,
                    help="pack the cohort into N scan lanes (0 = off)")
     p.add_argument("--packed_conv", type=str, default=defaults.packed_conv,
-                   choices=("off", "blockdiag", "grouped"),
+                   choices=("off", "blockdiag", "grouped", "auto"),
                    help="fedpack conv lowering for the packed lanes: one "
                         "block-diagonal GEMM / grouped conv across the K "
-                        "lanes instead of the per-lane vmap (off = vmap)")
+                        "lanes instead of the per-lane vmap (off = vmap); "
+                        "auto = fedplan picks per conv stage from the "
+                        "static roofline table (obs/plan.py)")
     p.add_argument("--host_pipeline_depth", type=int,
                    default=defaults.host_pipeline_depth,
                    help="prefetch this many future rounds' cohorts on "

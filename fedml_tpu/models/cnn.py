@@ -12,6 +12,8 @@ NHWC layout (TPU-native; torch reference is NCHW).
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -23,7 +25,7 @@ class CNNOriginalFedAvg(nn.Module):
     only_digits: bool = False
     conv_impl: str = "xla"   # "packed": fedpack client-packed convs over a
     #                          leading lane axis (ops/packed_conv.py)
-    packed_impl: str = "blockdiag"
+    packed_impl: Any = "blockdiag"  # name or per-stage LoweringPlan
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -74,7 +76,7 @@ class CNNDropOut(nn.Module):
 
     output_dim: int = 62
     conv_impl: str = "xla"   # "packed": fedpack lane-major body
-    packed_impl: str = "blockdiag"
+    packed_impl: Any = "blockdiag"  # name or per-stage LoweringPlan
 
     @nn.compact
     def __call__(self, x, train: bool = False, dropout_rng=None):

@@ -31,7 +31,9 @@ class BasicBlock(nn.Module):
     #                         (ops/conv_lanes.py); "packed": fedpack client-
     #                         packed convs on lane-major [K,N,H,W,C] input
     #                         (ops/packed_conv.py)
-    packed_impl: str = "blockdiag"  # packed lowering: blockdiag | grouped
+    packed_impl: Any = "blockdiag"  # packed lowering name (blockdiag |
+    #                                 grouped) or a per-stage fedplan
+    #                                 LoweringPlan (obs/plan.py)
     hw: tuple = (0, 0)      # static input (H, W) — lanes layout only
 
     def _norms(self, train: bool, axis: int = -1):
@@ -137,7 +139,7 @@ class CifarResNet(nn.Module):
     #                         C<=32 stages (docs/mfu_experiments.md H6);
     #                         "packed": fedpack client-packed convs over a
     #                         leading lane axis (ops/packed_conv.py)
-    packed_impl: str = "blockdiag"
+    packed_impl: Any = "blockdiag"  # name or per-stage LoweringPlan
 
     @nn.compact
     def __call__(self, x, train: bool = False):

@@ -44,6 +44,12 @@ from typing import Optional
 #: the output-channel dim and the reduction dim fill this many lanes.
 MXU_LANES = 128
 
+#: stage-FLOPs fraction below which a stage is "dominated": too small to
+#: matter for lowering/lane-count decisions (obs/plan.py flags rather than
+#: lets a tiny 1x1 shortcut conv flip a plan). Shared with summarize()'s
+#: per-stage ``dominated`` flag and ``dominated_frac`` total.
+DOMINATED_FRAC = 0.01
+
 # bf16 peak FLOP/s by TPU generation (public spec sheets), for MFU lines.
 # Moved from bench.py (PR 6) so the bench headline, the roofline report and
 # the trace analyzer divide by the same table.
@@ -529,7 +535,8 @@ def summarize(ops: list[dict], unknown_trip_counts: bool = False,
                 "useful_flops_per_invocation": 0.0,
                 "out_lane_ceiling": None, "red_lane_ceiling": None,
                 "packing": None,
-                "by_output_channels": {}, "top_ops": [],
+                "by_output_channels": {}, "dominated_frac": 0.0,
+                "top_ops": [],
                 "unknown_trip_counts": unknown_trip_counts}
     out_ceiling = sum(o["flops"] * o["count"] * o["out_lane_fill"]
                       for o in ops) / total
@@ -538,11 +545,16 @@ def summarize(ops: list[dict], unknown_trip_counts: bool = False,
     by_n: dict[int, float] = {}
     for o in ops:
         by_n[o["n"]] = by_n.get(o["n"], 0.0) + o["flops"] * o["count"]
+    # a stage whose FLOPs are < DOMINATED_FRAC of the program is flagged
+    # dominated: the planner/report must not let it steer a decision
     stage = {
         str(n): {"out_lane_fill": _lane_fill(n),
-                 "flops_frac": round(f / total, 4)}
+                 "flops_frac": round(f / total, 4),
+                 "dominated": f / total < DOMINATED_FRAC}
         for n, f in sorted(by_n.items())
     }
+    dominated_frac = sum(f for f in by_n.values()
+                         if f / total < DOMINATED_FRAC) / total
     top = sorted(ops, key=lambda o: -o["flops"] * o["count"])[:top_k]
     # fedpack accounting: streamed vs useful FLOPs. `.get` defaults keep
     # hand-built op rows (tests, older callers) working unchanged.
@@ -560,6 +572,7 @@ def summarize(ops: list[dict], unknown_trip_counts: bool = False,
         "red_lane_ceiling": round(red_ceiling, 4),
         "packing": packing,
         "by_output_channels": stage,
+        "dominated_frac": round(dominated_frac, 4),
         "top_ops": [
             {k: (round(v, 4) if isinstance(v, float) else v)
              for k, v in o.items() if k != "intensity"}
@@ -664,6 +677,44 @@ def cost_attribution_enabled() -> bool:
 _NO_ATTR = object()
 
 
+def _plan_self_check(name: str, plan, summary: dict) -> Optional[dict]:
+    """Post-first-call fedplan self-check: compare the realized program's
+    streamed-basis lane ceiling against the plan's parsed-basis prediction
+    and WARN (log + 'plan' registry counter) on divergence above the
+    plan's tolerance — a planner bug should be loud, not silent. The
+    realized program carries ops the per-stage micro-programs don't (dense
+    head, loss, optimizer), so the tolerance is deliberately loose."""
+    predicted = getattr(plan, "predicted_static_ceiling", None)
+    realized = summary.get("out_lane_ceiling")
+    if predicted is None or realized is None:
+        return None
+    tol = float(getattr(plan, "self_check_tol", 0.15))
+    delta = float(realized) - float(predicted)
+    ok = abs(delta) <= tol
+    if not ok:
+        import logging
+
+        logging.getLogger("fedml_tpu.cost").warning(
+            "fedplan self-check: program %r realized static lane ceiling "
+            "%.3f diverges from the plan's prediction %.3f by %+.3f "
+            "(tolerance %.3f) — the planner scored stages the program "
+            "does not run, or the lowering changed under it",
+            name, realized, predicted, delta, tol)
+        try:
+            # the plan module owns the long-lived 'plan' registry group
+            # (registry groups are weakref'd — a fresh group here would
+            # die, and its counter with it, before any snapshot)
+            from fedml_tpu.obs.plan import _plan_group
+
+            g = _plan_group()
+            g["self_check_warn"] = g.get("self_check_warn", 0) + 1
+        except Exception:
+            pass
+    return {"predicted_static_ceiling": float(predicted),
+            "realized_static_ceiling": float(realized),
+            "delta": round(delta, 4), "tolerance": tol, "ok": ok}
+
+
 def configure_from(config) -> bool:
     """Read ``config.cost_attribution``; a config without the attribute
     leaves the current setting untouched (mirrors tracer.configure_from)."""
@@ -709,11 +760,17 @@ def attribute_program(name: str, shape_key, fn, args) -> Optional[dict]:
             return None
         # fedpack hint (ops/packed_conv.py): programs whose builder marked
         # them as client-packed get their block-diag dots' packing_factor /
-        # useful-FLOP columns filled in and the summary recomputed
+        # useful-FLOP columns filled in and the summary recomputed. A
+        # plan-steered ("auto") program carries its LoweringPlan in the
+        # hints; its blockdiag stages' dots need the useful-FLOP division
+        # whenever ANY stage uses the block GEMM (plan.hint_impl).
         hints = getattr(fn, "cost_hints", None)
+        plan = (hints or {}).get("plan")
         if hints and hints.get("packing_factor", 1) > 1:
-            apply_packing(rep["ops"], int(hints["packing_factor"]),
-                          hints.get("packed_conv", "blockdiag"))
+            impl = hints.get("packed_conv", "blockdiag")
+            if plan is not None:
+                impl = getattr(plan, "hint_impl", impl)
+            apply_packing(rep["ops"], int(hints["packing_factor"]), impl)
             rep["summary"] = summarize(
                 rep["ops"], rep["summary"]["unknown_trip_counts"])
         record = {
@@ -725,6 +782,11 @@ def attribute_program(name: str, shape_key, fn, args) -> Optional[dict]:
             "xla_cost": rep["xla_cost"],
             "ops": rep["ops"],
         }
+        if plan is not None:
+            record["plan"] = plan.to_dict() if hasattr(plan, "to_dict") \
+                else plan
+            record["plan_self_check"] = _plan_self_check(
+                name, plan, rep["summary"])
         with _lock:
             _TABLES[name] = record
         from fedml_tpu.obs.tracer import tracer_if_enabled
@@ -743,6 +805,12 @@ def attribute_program(name: str, shape_key, fn, args) -> Optional[dict]:
                 "peak_bf16_flops": peak,
                 "peak_table_entry": entry,
             })
+            if plan is not None:
+                tr.instant("program_plan", cat="cost", args={
+                    "program": name,
+                    "plan": record.get("plan"),
+                    "self_check": record.get("plan_self_check"),
+                })
         return record
     except Exception:
         return None

@@ -66,8 +66,9 @@ __all__ = [
 ]
 
 #: registry namespaces exported as pulse "lanes" every snapshot ("packed"
-#: carries the fedpack fallback counters, parallel/packed.py)
-_LANES = ("time", "wire", "chaos", "compile", "packed")
+#: carries the fedpack fallback counters, parallel/packed.py; "plan" the
+#: fedplan cache/self-check counters, obs/plan.py)
+_LANES = ("time", "wire", "chaos", "compile", "packed", "plan")
 
 #: process-lifetime stats for the conftest session summary (NEVER reset by
 #: configure()/reset() — they describe the session, not one run).

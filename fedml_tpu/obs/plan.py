@@ -1,0 +1,612 @@
+"""fedplan: cost-model-steered per-stage conv lowering selection.
+
+fedpack (ops/packed_conv.py) gave the packed schedule three lowerings per
+conv — ``blockdiag`` / ``grouped`` / ``off`` — but one GLOBAL flag the user
+must guess, while the right answer is stage-dependent: a C=16 stage at K=4
+fills only half the MXU output lanes under any useful-only lowering (the
+block-diagonal GEMM's explicit K*Co lanes buy real fill there), whereas a
+C=64 stage already saturates at K*Co >= 128 and the block form just streams
+K x structural zeros through full lanes. fedcost (obs/cost.py) derives all
+of this from pre-optimization HLO with no compile and no execution — this
+module closes the ROADMAP loop and uses that table to *choose*.
+
+:class:`LoweringPlanner` (via :func:`plan_lowering`) discovers the model's
+forward conv stages by lowering the STANDARD model once, then scores each
+``{blockdiag, grouped, off}`` candidate per stage by lowering a tiny
+fwd+grad micro-program of just that conv at K lanes and reading fedcost's
+table back. Scoring is lexicographic on
+
+1. **effective output-lane ceiling** — the flop-weighted streamed-basis
+   ceiling of the candidate's micro-program, where the ``grouped``
+   candidate's lane-folding convs (``feature_group_count=K``) are credited
+   with the H4 expansion fill ``min(K*N_group, 128)/128``: docs/perf.md H4
+   measured the TPU backend expanding the *explicit* grouped op
+   block-diagonally itself, so its realizable lanes are the expanded ones
+   while its streamed FLOPs stay useful-only. The per-lane vmap (``off``)
+   lowers to the statically identical grouped conv but is scored at its
+   parsed per-group fill — it is the probe's control, and the asymmetry
+   encodes exactly the H4 bet that ``lanes_probe --mode auto`` adjudicates
+   on silicon;
+2. **useful-FLOPs fraction** (a lane-equal tie goes to the lowering that
+   does not stream K x structural zeros);
+3. fewer operand bytes (no explicit im2col patch matrix).
+
+The plan's headline ``predicted_ceiling`` is the USEFUL-flop-weighted
+effective ceiling over the chosen stages. Useful FLOPs are invariant
+across candidates, so the per-stage argmax provably dominates every
+uniform (single global flag) assignment on the same metric —
+``uniform_ceiling(impl)`` is computed from the same candidate records so
+tests can pin the inequality. ``predicted_static_ceiling`` is the
+streamed-basis parsed prediction that the post-first-call self-check in
+``obs/cost.attribute_program`` compares against the realized program's
+ceiling (a planner bug should be loud, not silent).
+
+Caching: candidate micro-lowerings are cached per
+``(stage_shape, K, dtype, batch, impl, jax_version)`` and whole plans per
+``(model_name, stage_shapes, K, dtype, batch, jax_version)`` — repeated
+runs and the prefetcher never re-lower a candidate. Hit/miss counts feed
+the ``[t1] plan-cache:`` conftest session line and the ``plan`` registry
+lane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+import threading
+from typing import Any, Optional
+
+from fedml_tpu.obs import cost as _cost
+
+log = logging.getLogger("fedml_tpu.plan")
+
+__all__ = [
+    "PlanStage", "LoweringPlan", "plan_lowering", "score_stage",
+    "cache_stats", "reset_plan_cache", "DEFAULT_SELF_CHECK_TOL",
+]
+
+#: candidate lowerings enumerated per stage, in tie-break preference order
+#: (later entries win ties on equal (ceiling, useful_frac, bytes) only by
+#: being scored first — the sort is stable)
+CANDIDATE_IMPLS = ("blockdiag", "grouped", "off")
+
+#: |realized - predicted| static-ceiling divergence (absolute, on the
+#: streamed basis) above which the post-first-call self-check warns. The
+#: realized round program carries ops the per-stage micro-programs do not
+#: (dense head, loss, optimizer dots), so the default absorbs that skew.
+DEFAULT_SELF_CHECK_TOL = 0.15
+
+_WINDOW_RE = re.compile(r"window=\{([^}]*)\}")
+_WIN_SIZE_RE = re.compile(r"size=(\d+)x(\d+)")
+_WIN_STRIDE_RE = re.compile(r"stride=(\d+)x(\d+)")
+_WIN_PAD_RE = re.compile(r"pad=([0-9_x]+)")
+
+
+# -- plan data model ---------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PlanStage:
+    """One forward conv stage of the model with its chosen lowering. All
+    fields are hashable scalars/tuples: the plan travels inside flax module
+    fields and jit closures, so it must hash and compare by value."""
+
+    kh: int
+    kw: int
+    ci: int
+    co: int
+    strides: int
+    h: int
+    w: int
+    padding: str
+    count: int                 # identical call sites in the forward pass
+    impl: str                  # winner: blockdiag | grouped | off
+    eff_ceiling: float         # winner's effective out-lane ceiling
+    ceiling: float             # winner's parsed (streamed-basis) ceiling
+    useful_frac: float         # winner's useful/streamed FLOPs
+    flops_frac: float          # stage useful FLOPs / model conv total
+    dominated: bool            # flops_frac < cost.DOMINATED_FRAC
+    #: (impl, eff_ceiling, reason-it-lost) per rejected candidate
+    alternatives: tuple = ()
+
+    @property
+    def shape(self) -> tuple:
+        return (self.kh, self.kw, self.ci, self.co, self.strides,
+                self.h, self.w, self.padding)
+
+    def label(self) -> str:
+        short = {"blockdiag": "bd", "grouped": "grp", "off": "off"}
+        tag = f"{short.get(self.impl, self.impl)}@{self.co}"
+        return tag + (f"x{self.count}" if self.count > 1 else "")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoweringPlan:
+    """Per-stage impl map plus the static predictions it was chosen by.
+
+    Accepted anywhere a ``packed_conv`` lowering string is today: the
+    packed flax ``Conv`` resolves its stage through :meth:`impl_for`, the
+    fallback machinery labels it "auto", and ``cost_hints['plan']`` rides
+    it into ``attribute_program`` for the self-check.
+    """
+
+    model_name: str
+    lanes: int
+    dtype: str
+    batch: int
+    jax_version: str
+    stages: tuple            # tuple[PlanStage, ...]
+    predicted_ceiling: float          # useful-weighted effective basis
+    predicted_static_ceiling: float   # streamed-weighted parsed basis
+    useful_flops_frac: float
+    #: ((impl, useful-weighted eff ceiling if used globally), ...)
+    uniform: tuple = ()
+    self_check_tol: float = DEFAULT_SELF_CHECK_TOL
+
+    def impl_for(self, kh: int, kw: int, ci: int, co: int, strides: int,
+                 h: int, w: int) -> str:
+        """Resolve one conv call site to its lowering: exact stage-shape
+        match first, then spatial-agnostic (a packed twin may see padded
+        spatial dims), else 'grouped' — useful-only, valid for any conv."""
+        for s in self.stages:
+            if (s.kh, s.kw, s.ci, s.co, s.strides, s.h, s.w) == \
+                    (kh, kw, ci, co, strides, h, w):
+                return s.impl
+        for s in self.stages:
+            if (s.kh, s.kw, s.ci, s.co, s.strides) == \
+                    (kh, kw, ci, co, strides):
+                return s.impl
+        return "grouped"
+
+    @property
+    def hint_impl(self) -> str:
+        """The ``apply_packing`` impl hint for a program built from this
+        plan: 'blockdiag' whenever ANY stage uses the block GEMM (its dots
+        must get useful-FLOP columns), else 'grouped'."""
+        return ("blockdiag"
+                if any(s.impl == "blockdiag" for s in self.stages)
+                else "grouped")
+
+    def selection_ceiling(self) -> float:
+        """Predicted ceiling over NON-dominated stages only — the metric
+        lane-count selection compares, so a tiny 1x1 stage (<1% of the
+        program's FLOPs, obs/cost.DOMINATED_FRAC) can never flip K."""
+        live = [s for s in self.stages if not s.dominated] or list(self.stages)
+        den = sum(s.flops_frac for s in live)
+        if den <= 0:
+            return self.predicted_ceiling
+        return sum(s.flops_frac * s.eff_ceiling for s in live) / den
+
+    def uniform_ceiling(self, impl: str) -> Optional[float]:
+        for name, ceil in self.uniform:
+            if name == impl:
+                return ceil
+        return None
+
+    def summary_str(self) -> str:
+        stages = " ".join(s.label() for s in self.stages)
+        return f"K={self.lanes} {stages} pred={self.predicted_ceiling:.3f}"
+
+    def to_dict(self) -> dict:
+        return {
+            "model": self.model_name,
+            "lanes": self.lanes,
+            "dtype": self.dtype,
+            "batch": self.batch,
+            "jax_version": self.jax_version,
+            "predicted_ceiling": self.predicted_ceiling,
+            "predicted_static_ceiling": self.predicted_static_ceiling,
+            "useful_flops_frac": self.useful_flops_frac,
+            "uniform": {k: v for k, v in self.uniform},
+            "summary": self.summary_str(),
+            "stages": [
+                {"kh": s.kh, "kw": s.kw, "ci": s.ci, "co": s.co,
+                 "strides": s.strides, "h": s.h, "w": s.w,
+                 "padding": s.padding, "count": s.count, "impl": s.impl,
+                 "eff_ceiling": s.eff_ceiling, "ceiling": s.ceiling,
+                 "useful_frac": s.useful_frac, "flops_frac": s.flops_frac,
+                 "dominated": s.dominated,
+                 "alternatives": [list(a) for a in s.alternatives]}
+                for s in self.stages
+            ],
+        }
+
+
+# -- caches (the plan key contract, DESIGN.md §15) ---------------------------
+
+_lock = threading.Lock()
+#: (stage_shape, K, dtype, batch, impl, jax_version) -> candidate record
+_CANDIDATES: dict = {}
+#: (model_name, stage_shapes, K, dtype, batch, jax_version) -> LoweringPlan
+_PLANS: dict = {}
+#: (model_name, input_shape, input_dtype, batch, jax_version) -> stage list
+#: — discovery lowers the WHOLE standard eval apply, the single most
+#: expensive lowering in a plan build, and is K/impl-independent
+_STAGES: dict = {}
+#: process-lifetime hit/miss counts for the conftest ``[t1] plan-cache:``
+#: session line — NEVER reset by reset_plan_cache (they describe the
+#: session, not one run)
+_SESSION = {"hits": 0, "misses": 0}
+_REG_GROUP: dict = {"group": None}
+
+
+def _plan_group():
+    g = _REG_GROUP["group"]
+    if g is None:
+        from fedml_tpu.obs import default_registry
+
+        g = _REG_GROUP["group"] = default_registry().group("plan")
+    return g
+
+
+def _count(kind: str) -> None:
+    if kind in _SESSION:
+        _SESSION[kind] += 1
+    try:
+        g = _plan_group()
+        g[kind] = g.get(kind, 0) + 1
+    except Exception:
+        pass
+
+
+def cache_stats() -> dict:
+    """Process-lifetime candidate/plan cache hits and misses (a miss =
+    one real ``jit(...).lower()``)."""
+    with _lock:
+        return dict(_SESSION)
+
+
+def reset_plan_cache() -> None:
+    """Drop cached candidates/plans (tests); session hit/miss counts and
+    the registry group handle survive by design."""
+    with _lock:
+        _CANDIDATES.clear()
+        _PLANS.clear()
+        _STAGES.clear()
+    _REG_GROUP["group"] = None
+
+
+# -- stage discovery ---------------------------------------------------------
+
+def _parse_window(line: str):
+    """(kh, kw, stride, padding) from an HLO conv's window attr; spatial
+    window defaults are printed only when non-trivial."""
+    kh = kw = stride = 1
+    padded = False
+    m = _WINDOW_RE.search(line)
+    if m:
+        win = m.group(1)
+        ms = _WIN_SIZE_RE.search(win)
+        if ms:
+            kh, kw = int(ms.group(1)), int(ms.group(2))
+        mst = _WIN_STRIDE_RE.search(win)
+        if mst:
+            stride = int(mst.group(1))
+        mp = _WIN_PAD_RE.search(win)
+        if mp:
+            padded = any(int(p) for p in re.split("[_x]", mp.group(1)))
+    # 1x1 SAME == VALID; report SAME so micro-programs match either way
+    padding = "SAME" if (padded or (kh == 1 and kw == 1)) else "VALID"
+    return kh, kw, stride, padding
+
+
+def _fwd_conv_stages(hlo_text: str) -> list[dict]:
+    """The model's forward conv stages from its lowered eval HLO: one
+    entry per distinct (kh, kw, ci, co, strides, h, w, padding), with the
+    number of identical call sites. Grouped convs (fgc > 1) are skipped —
+    standard models have none; a future depthwise family would plan its
+    own stages."""
+    mod = _cost.parse_hlo_module(hlo_text)
+    mult, _unknown = _cost._comp_multipliers(mod)
+    stages: dict[tuple, int] = {}
+    for cname, comp in mod["computations"].items():
+        count = mult.get(cname, 0)
+        if count <= 0:
+            continue
+        for instr in comp.values():
+            if instr["op"] != "convolution":
+                continue
+            m = _cost._DIM_LABELS_RE.search(instr["line"])
+            if not m or instr["dims"] is None:
+                continue
+            lhs_spec, ker_spec, out_spec = m.groups()
+            fgc = 1
+            mg = _cost._ATTR_INT_RE["feature_group_count"].search(
+                instr["line"])
+            if mg:
+                fgc = int(mg.group(1))
+            if fgc != 1:
+                continue
+            kernel = comp.get(instr["operands"][1]) \
+                if len(instr["operands"]) > 1 else None
+            lhs = comp.get(instr["operands"][0]) \
+                if instr["operands"] else None
+            if kernel is None or kernel.get("dims") is None \
+                    or lhs is None or lhs.get("dims") is None:
+                continue
+            kdims, ldims = kernel["dims"], lhs["dims"]
+            if len(kdims) != len(ker_spec) or len(ldims) != len(lhs_spec):
+                continue
+            ci = next((kdims[i] for i, ch in enumerate(ker_spec)
+                       if ch == "i"), 1)
+            co = next((kdims[i] for i, ch in enumerate(ker_spec)
+                       if ch == "o"), 1)
+            spatial = [ldims[i] for i, ch in enumerate(lhs_spec)
+                       if ch.isdigit()]
+            if len(spatial) != 2:
+                continue
+            kh, kw, stride, padding = _parse_window(instr["line"])
+            key = (int(kh), int(kw), int(ci), int(co), int(stride),
+                   int(spatial[0]), int(spatial[1]), padding)
+            stages[key] = stages.get(key, 0) + int(count)
+    return [
+        {"kh": k[0], "kw": k[1], "ci": k[2], "co": k[3], "strides": k[4],
+         "h": k[5], "w": k[6], "padding": k[7], "count": n}
+        for k, n in sorted(stages.items())
+    ]
+
+
+def model_conv_stages(bundle, batch: int = 4) -> list[dict]:
+    """Discover a bundle's forward conv stages by lowering the STANDARD
+    model's eval apply once (abstract avals only — no init, no compile).
+    Cached per (model, input shape/dtype, batch, jax version): discovery
+    is K/impl-independent and the whole-model lowering is the single most
+    expensive step of a plan build."""
+    import jax
+    import jax.numpy as jnp
+
+    key = (bundle.name, tuple(bundle.input_shape),
+           str(bundle.input_dtype), batch, jax.__version__)
+    with _lock:
+        hit = _STAGES.get(key)
+    if hit is not None:
+        return [dict(s) for s in hit]
+    x = jax.ShapeDtypeStruct((batch,) + tuple(bundle.input_shape),
+                             bundle.input_dtype)
+    variables = jax.eval_shape(
+        lambda r: bundle.init(r, batch_size=batch), jax.random.PRNGKey(0))
+    lowered = jax.jit(bundle.apply_eval).lower(variables, x)
+    stages = _fwd_conv_stages(
+        lowered.compiler_ir(dialect="hlo").as_hlo_text())
+    with _lock:
+        _STAGES[key] = stages
+    return [dict(s) for s in stages]
+
+
+# -- per-stage candidate scoring ---------------------------------------------
+
+def _stage_bytes(ops) -> float:
+    return float(sum(o["bytes"] * o["count"] for o in ops))
+
+
+def _eff_out_ceiling(ops, lanes: int, credit_grouped: bool) -> float:
+    """Flop-weighted effective output-lane ceiling: parsed fills, except
+    that (when ``credit_grouped``) lane-folding grouped convs get the H4
+    expansion fill ``min(K*N_group, 128)/128`` — the mapping the TPU
+    backend was measured to pick for the explicit fgc=K op."""
+    total = sum(o["flops"] * o["count"] for o in ops)
+    if total <= 0:
+        return 0.0
+    acc = 0.0
+    for o in ops:
+        fill = o["out_lane_fill"]
+        if (credit_grouped and o["kind"] == "conv"
+                and o["groups"] == lanes and o["n"] > 1
+                and o["n"] != o["k"]):
+            fill = min(o["n"] * lanes, _cost.MXU_LANES) / _cost.MXU_LANES
+        acc += o["flops"] * o["count"] * fill
+    return acc / total
+
+
+def _lower_candidate(stage: dict, impl: str, lanes: int, dtype_name: str,
+                     batch: int) -> dict:
+    """Lower ONE (stage, impl, K) fwd+grad micro-program and read fedcost's
+    table back. jit(...).lower() only — no compile, no execution."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.ops import packed_conv as pc
+
+    fn = {"blockdiag": pc.conv_blockdiag, "grouped": pc.conv_grouped,
+          "off": pc.conv_vmap}[impl]
+    dt = jnp.dtype(dtype_name)
+    strides, padding = stage["strides"], stage["padding"]
+
+    def loss(xs, ws):
+        y = fn(xs, ws, strides, padding)
+        return jnp.sum(jnp.square(y.astype(jnp.float32)))
+
+    xs = jax.ShapeDtypeStruct(
+        (lanes, batch, stage["h"], stage["w"], stage["ci"]), dt)
+    ws = jax.ShapeDtypeStruct(
+        (lanes, stage["kh"], stage["kw"], stage["ci"], stage["co"]), dt)
+    lowered = jax.jit(jax.grad(loss, argnums=(0, 1))).lower(xs, ws)
+    ops, unknown = _cost.op_table(
+        lowered.compiler_ir(dialect="hlo").as_hlo_text())
+    _cost.apply_packing(
+        ops, lanes, "blockdiag" if impl == "blockdiag" else "grouped")
+    streamed = sum(o["flops"] * o["count"] for o in ops)
+    useful = sum(o.get("useful_flops", o["flops"]) * o["count"] for o in ops)
+    return {
+        "impl": impl,
+        "lanes": lanes,
+        "ceiling": _eff_out_ceiling(ops, lanes, credit_grouped=False),
+        "eff_ceiling": _eff_out_ceiling(
+            ops, lanes, credit_grouped=(impl == "grouped")),
+        "streamed_flops": streamed,
+        "useful_flops": useful,
+        "useful_frac": (useful / streamed) if streamed else 1.0,
+        "bytes": _stage_bytes(ops),
+        "unknown_trip_counts": unknown,
+    }
+
+
+def _candidate(stage: dict, impl: str, lanes: int, dtype_name: str,
+               batch: int) -> dict:
+    shape = (stage["kh"], stage["kw"], stage["ci"], stage["co"],
+             stage["strides"], stage["h"], stage["w"], stage["padding"])
+    import jax
+
+    key = (shape, lanes, dtype_name, batch, impl, jax.__version__)
+    with _lock:
+        hit = _CANDIDATES.get(key)
+    if hit is not None:
+        _count("hits")
+        return hit
+    _count("misses")
+    rec = _lower_candidate(stage, impl, lanes, dtype_name, batch)
+    with _lock:
+        _CANDIDATES[key] = rec
+    return rec
+
+
+def _lost_reason(cand: dict, winner: dict) -> str:
+    if cand["eff_ceiling"] + 1e-9 < winner["eff_ceiling"]:
+        return (f"lower effective lane ceiling "
+                f"({cand['eff_ceiling']:.3f} vs {winner['eff_ceiling']:.3f})")
+    if cand["useful_frac"] + 1e-9 < winner["useful_frac"]:
+        return (f"lane-equal but streams {cand['lanes']}x structural zeros "
+                f"(useful {cand['useful_frac']:.2f} vs "
+                f"{winner['useful_frac']:.2f})")
+    if cand["impl"] == "off":
+        return ("statically identical grouped-conv lowering without the "
+                "explicit fgc=K mapping (H4 expansion credit goes to the "
+                "explicit op; per-lane vmap is the probe's control)")
+    return (f"tie broken on operand bytes "
+            f"({cand['bytes']:.0f} vs {winner['bytes']:.0f})")
+
+
+def score_stage(stage: dict, lanes: int, dtype_name: str = "float32",
+                batch: int = 4) -> dict:
+    """All candidate records for one stage at K lanes, plus the winner by
+    the documented lexicographic score. Public for ``lanes_probe --mode
+    auto``, which replays exactly this choice against measured time."""
+    cands = [_candidate(stage, impl, lanes, dtype_name, batch)
+             for impl in CANDIDATE_IMPLS]
+    ranked = sorted(
+        cands, key=lambda c: (c["eff_ceiling"], c["useful_frac"],
+                              -c["bytes"]), reverse=True)
+    winner = ranked[0]
+    return {
+        "stage": dict(stage),
+        "winner": winner,
+        "candidates": {c["impl"]: c for c in cands},
+        "reasons": {c["impl"]: _lost_reason(c, winner)
+                    for c in cands if c is not winner},
+    }
+
+
+# -- the planner -------------------------------------------------------------
+
+def _stage_weight(s: dict) -> float:
+    """Canonical useful FLOPs of a scored stage: the ``off`` candidate's
+    parsed count (pure conv work, no patch machinery) times call sites.
+    Using ONE impl-invariant weight for every candidate is what makes the
+    per-stage argmax provably dominate every uniform assignment."""
+    return s["candidates"]["off"]["useful_flops"] * s["stage"]["count"]
+
+
+def _weighted_ceiling(scored: list[dict], pick) -> float:
+    """Useful-flop-weighted effective ceiling over stages, each stage's
+    candidate chosen by ``pick(scored_stage) -> candidate``."""
+    num = den = 0.0
+    for s in scored:
+        w = _stage_weight(s)
+        num += w * pick(s)["eff_ceiling"]
+        den += w
+    return num / den if den else 0.0
+
+
+def plan_lowering(bundle, lanes, dtype=None, batch: int = 4,
+                  self_check_tol: float = DEFAULT_SELF_CHECK_TOL
+                  ) -> LoweringPlan:
+    """Build the :class:`LoweringPlan` for ``bundle`` at ``lanes``
+    co-scheduled clients.
+
+    ``lanes`` may be an int (the execution path passes the concrete lane
+    count the packing schedule already fixed) or a sequence of candidate
+    counts (planning tools): each K is planned and the one with the best
+    predicted ceiling over NON-dominated stages wins — a tiny 1x1 stage
+    never flips the lane count (the ``dominated_frac`` contract,
+    obs/cost.py). ``dtype`` defaults to the module's compute dtype.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(lanes, int):
+        ks = sorted({int(k) for k in lanes if int(k) > 1})
+        if not ks:
+            raise ValueError(f"no usable lane candidates in {lanes!r}")
+        plans = [plan_lowering(bundle, k, dtype=dtype, batch=batch,
+                               self_check_tol=self_check_tol) for k in ks]
+        return max(plans, key=lambda p: p.selection_ceiling())
+    if lanes < 2:
+        raise ValueError("plan_lowering needs lanes >= 2 (one lane has "
+                         "nothing to co-schedule; resolve 'off' instead)")
+
+    dt = jnp.dtype(dtype if dtype is not None
+                   else getattr(bundle.module, "dtype", jnp.float32))
+    dtype_name = dt.name
+    stages = model_conv_stages(bundle, batch=batch)
+    if not stages:
+        raise ValueError(
+            f"model {bundle.name!r} has no forward conv stages to plan")
+    shapes = tuple(
+        (s["kh"], s["kw"], s["ci"], s["co"], s["strides"], s["h"], s["w"],
+         s["padding"], s["count"]) for s in stages)
+    pkey = (bundle.name, shapes, lanes, dtype_name, batch, jax.__version__)
+    with _lock:
+        hit = _PLANS.get(pkey)
+    if hit is not None:
+        _count("hits")
+        return hit
+
+    scored = [score_stage(s, lanes, dtype_name, batch) for s in stages]
+    model_useful = sum(_stage_weight(s) for s in scored) or 1.0
+
+    plan_stages = []
+    for s in scored:
+        st, w = s["stage"], s["winner"]
+        frac = _stage_weight(s) / model_useful
+        plan_stages.append(PlanStage(
+            kh=st["kh"], kw=st["kw"], ci=st["ci"], co=st["co"],
+            strides=st["strides"], h=st["h"], w=st["w"],
+            padding=st["padding"], count=st["count"], impl=w["impl"],
+            eff_ceiling=round(w["eff_ceiling"], 4),
+            ceiling=round(w["ceiling"], 4),
+            useful_frac=round(w["useful_frac"], 4),
+            flops_frac=round(frac, 4),
+            dominated=frac < _cost.DOMINATED_FRAC,
+            alternatives=tuple(
+                (impl, round(s["candidates"][impl]["eff_ceiling"], 4),
+                 reason)
+                for impl, reason in sorted(s["reasons"].items())),
+        ))
+
+    predicted = _weighted_ceiling(scored, lambda s: s["winner"])
+    uniform = tuple(
+        (impl, round(_weighted_ceiling(
+            scored, lambda s, i=impl: s["candidates"][i]), 4))
+        for impl in CANDIDATE_IMPLS)
+    # streamed-basis parsed prediction for the realized-program self-check
+    num = den = 0.0
+    for s in scored:
+        w = s["winner"]
+        f = w["streamed_flops"] * s["stage"]["count"]
+        num += f * w["ceiling"]
+        den += f
+    streamed_total = den or 1.0
+    useful_total = sum(_stage_weight(s) for s in scored)
+
+    plan = LoweringPlan(
+        model_name=bundle.name, lanes=lanes, dtype=dtype_name, batch=batch,
+        jax_version=jax.__version__, stages=tuple(plan_stages),
+        predicted_ceiling=round(predicted, 4),
+        predicted_static_ceiling=round(num / streamed_total, 4),
+        useful_flops_frac=round(useful_total / streamed_total, 4),
+        uniform=uniform, self_check_tol=self_check_tol)
+    with _lock:
+        _PLANS[pkey] = plan
+    _count("built")
+    log.info("fedplan %s: %s", bundle.name, plan.summary_str())
+    return plan

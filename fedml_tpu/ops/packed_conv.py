@@ -55,7 +55,7 @@ from jax import lax
 __all__ = [
     "stack_variables", "unstack_variables",
     "block_diag_weight", "block_diag_unstack",
-    "conv_blockdiag", "conv_grouped", "conv_vmap",
+    "conv_blockdiag", "conv_grouped", "conv_vmap", "resolve_impl",
     "seed_dropout", "lane_dropout",
     "Conv", "BatchNorm", "Dense",
 ]
@@ -226,7 +226,21 @@ def conv_vmap(xs: jnp.ndarray, ws: jnp.ndarray, strides: int = 1,
 
 
 _IMPLS = {"blockdiag": conv_blockdiag, "grouped": conv_grouped,
-          "vmap": conv_vmap}
+          "vmap": conv_vmap, "off": conv_vmap}
+
+
+def resolve_impl(impl, k: int, kernel_size: int, ci: int, co: int,
+                 strides: int, h: int, w: int) -> str:
+    """One conv call site's lowering name from a model-global string OR a
+    per-stage :class:`~fedml_tpu.obs.plan.LoweringPlan` (fedplan): plans
+    resolve by the call site's static stage shape, so ONE packed module
+    tree can mix blockdiag/grouped/off convs per stage. 'off' per stage
+    means the per-lane vmap for that conv only — bit-exact vs the global
+    'off' path because conv_vmap IS that path's lowering."""
+    del k
+    if isinstance(impl, str):
+        return impl
+    return impl.impl_for(kernel_size, kernel_size, ci, co, strides, h, w)
 
 
 # -- flax modules (auto-named to match the standard models' param paths) -----
@@ -235,14 +249,16 @@ class Conv(nn.Module):
     """Packed drop-in for ``nn.Conv(features, (k,k), strides, padding)`` on
     lane-major input [K, N, H, W, Ci]. Parameter paths and per-lane shapes
     match nn.Conv ('kernel' [K,k,k,Ci,Co], optional 'bias' [K,Co], f32) —
-    the leading K axis is the packing axis of stack_variables."""
+    the leading K axis is the packing axis of stack_variables. ``impl`` is
+    a lowering name ('blockdiag' | 'grouped' | 'off'/'vmap') or a fedplan
+    :class:`~fedml_tpu.obs.plan.LoweringPlan` resolved per stage shape."""
 
     features: int
     kernel_size: int = 3
     strides: int = 1
     padding: str = "SAME"
     use_bias: bool = True
-    impl: str = "blockdiag"
+    impl: Any = "blockdiag"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -252,8 +268,10 @@ class Conv(nn.Module):
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
                             (k, ks, ks, ci, self.features), jnp.float32)
         xs = xs.astype(self.dtype)
-        y = _IMPLS[self.impl](xs, kernel.astype(self.dtype),
-                              self.strides, self.padding)
+        impl = resolve_impl(self.impl, k, ks, ci, self.features,
+                            self.strides, xs.shape[2], xs.shape[3])
+        y = _IMPLS[impl](xs, kernel.astype(self.dtype),
+                         self.strides, self.padding)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,
                               (k, self.features), jnp.float32)

@@ -327,7 +327,34 @@ def reset_fallback_warnings() -> None:
     _FALLBACK_STATE["group"] = None
 
 
-def packed_fallback_reason(bundle: ModelBundle, packed_conv: str,
+def impl_label(packed_conv) -> str:
+    """Short string form of a lowering selector for counter keys, log
+    lines and cost hints: the flag string itself, or 'auto' for a fedplan
+    :class:`~fedml_tpu.obs.plan.LoweringPlan` (its per-stage detail rides
+    ``cost_hints['plan']``, not the label)."""
+    return packed_conv if isinstance(packed_conv, str) else "auto"
+
+
+def resolve_packed_conv(packed_conv, bundle: ModelBundle, n_lanes: int,
+                        dtype=None, optimizer: str = "sgd"):
+    """Resolve the ``--packed_conv`` flag to what the builders consume at
+    program-build time: concrete flags pass through; ``'auto'`` becomes
+    the fedplan :class:`~fedml_tpu.obs.plan.LoweringPlan` for this bundle
+    at the schedule's ACTUAL lane count — or ``'off'`` (with the
+    documented :func:`packed_fallback_reason` warning downstream) when the
+    joint form cannot apply (no packed twin, flax-rng dropout, or a
+    single-lane schedule with nothing to co-schedule)."""
+    if packed_conv != "auto":
+        return packed_conv
+    if n_lanes < 2 or packed_fallback_reason(
+            bundle, "auto", optimizer) is not None:
+        return "off"
+    from fedml_tpu.obs.plan import plan_lowering
+
+    return plan_lowering(bundle, int(n_lanes), dtype=dtype)
+
+
+def packed_fallback_reason(bundle: ModelBundle, packed_conv,
                            optimizer: str = "sgd") -> Optional[str]:
     """Why the joint form does NOT apply (None = it does). After the
     packed-everywhere refactor the only remaining reasons are genuinely
@@ -367,15 +394,16 @@ def _packed_model_bundle(bundle: ModelBundle, packed_conv: str,
     reason = packed_fallback_reason(bundle, packed_conv, optimizer)
     if reason is not None:
         if packed_conv not in (None, "", "off"):
+            label = impl_label(packed_conv)
             g = _fallback_group()
-            ck = f"fallback:{bundle.name}:{packed_conv}"
+            ck = f"fallback:{bundle.name}:{label}"
             g[ck] = g.get(ck, 0) + 1
-            key = (bundle.name, packed_conv, reason)
+            key = (bundle.name, label, reason)
             if key not in _FALLBACK_STATE["seen"]:
                 _FALLBACK_STATE["seen"].add(key)
                 log.warning(
                     "packed_conv=%r falls back to the per-lane vmap: %s",
-                    packed_conv, reason)
+                    label, reason)
         return None
     return bundle.packed_variant(packed_conv)
 

@@ -58,7 +58,7 @@ def test_committed_trajectory_values():
     """Pin the parsed trajectory itself: the committed series IS the
     baseline the gate compares future artifacts against."""
     rows = br.load_series(COMMITTED)
-    assert [r["n"] for r in rows] == [1, 2, 3, 4, 5, 6]
+    assert [r["n"] for r in rows] == [1, 2, 3, 4, 5, 6, 7]
     traj = {r["n"]: r for r in rows}
     assert traj[1]["vs_baseline"] == pytest.approx(1.6)
     assert traj[1]["mfu"] is None          # mfu starts at r02
@@ -75,6 +75,19 @@ def test_committed_trajectory_values():
     assert traj[6]["clients_per_sec"] > 46.83   # above r05 despite 1 core
     assert traj[6]["_basis"] is not None and traj[5]["_basis"] is None
     assert traj[5]["xdev_cohort"] == pytest.approx(50)  # key predates r06
+    # r07 (fedplan, ISSUE 18): the tiny-scale auto arm — the resolved
+    # MIXED plan rides the artifact (its summary is the `plan` column; the
+    # starved 16-channel stages pick the block GEMM, the saturated ones
+    # keep grouped) and the lifted packed ceiling beats r06's uniform arm.
+    # Tiny-scale resnet56 is a new host basis vs r06's full-scale lr run,
+    # so throughput re-bases rather than gating.
+    assert traj[7]["packed_plan"].startswith("K=4 ")
+    assert "bd@16" in traj[7]["packed_plan"]
+    assert "grp@" in traj[7]["packed_plan"]
+    assert "pred=0.919" in traj[7]["packed_plan"]
+    assert traj[7]["packed_lane_ceiling"] > traj[6]["packed_lane_ceiling"]
+    assert traj[6]["packed_plan"] is None   # key predates r07
+    assert traj[7]["_basis"] is not None
 
 
 def _regressed_copy(tmp_path, metric_mutator):
@@ -181,8 +194,8 @@ def test_sketch_columns_render_dash_on_presketch_artifacts(capsys):
     assert "cohort size" in out.out and "policy" in out.out
     header, *rows = [l for l in out.out.splitlines() if l.strip()]
     for row in rows:
-        if row.lstrip().startswith("r06"):
-            assert row.rstrip().endswith("speed")  # the fedsched arm
+        if row.lstrip().startswith(("r06", "r07")):
+            assert row.rstrip().endswith("speed")  # fedsched/fedplan arms
         elif row.lstrip().startswith("r0"):
             assert row.rstrip().endswith("-")      # policy column empty
 

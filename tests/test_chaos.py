@@ -107,6 +107,7 @@ def test_chaos_local_completes_exact_once(strict_run):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # ~9 s: grpc twin of the local exact-once pins
 def test_chaos_grpc_completes_exact_once():
     pytest.importorskip("grpc")
     from fedml_tpu.comm.grpc_backend import GRPCCommManager
@@ -396,6 +397,8 @@ class TestProtocolChaosRoundtrip:
                 np.asarray(a, np.float32), np.asarray(b, np.float32),
                 rtol=1e-5)
 
+    @pytest.mark.slow  # ~9 s: third protocol through the same roundtrip
+    #                     harness; base + decentralized stay in-budget
     def test_vfl_chaos_roundtrip(self):
         from fedml_tpu.data.vertical import make_synthetic_vertical
         from fedml_tpu.distributed.vfl_edge import run_vfl_edge

@@ -140,6 +140,8 @@ def test_ft_healthy_run_is_bit_identical_to_strict():
            [h["loss"] for h in strict.test_history]
 
 
+@pytest.mark.slow  # ~16 s of deadline sleeps; the subset-crash and rejoin
+#                    pins keep the teardown semantics in-budget
 def test_all_workers_crash_tears_down_instead_of_hanging():
     """The reference hangs forever here (check_whether_all_receive waits for
     ALL workers until the MPI abort). With every worker dead the federation

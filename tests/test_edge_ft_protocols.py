@@ -108,6 +108,7 @@ class TestTAThreshold:
         assert server.history["round"] == [0, 1, 2]
         assert all(np.isfinite(l) for l in server.history["Test/Loss"])
 
+    @pytest.mark.slow  # ~10 s: grpc twin of the local kill test above
     def test_threshold_over_grpc_with_kill(self, monkeypatch):
         """The same between-phases kill over real gRPC sockets."""
         pytest.importorskip("grpc")

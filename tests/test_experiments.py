@@ -185,6 +185,8 @@ def test_every_algorithm_has_a_main_alias():
         assert hasattr(mod, "main")
 
 
+@pytest.mark.slow  # ~27 s: full bench.py tiny run; the committed BENCH_r*
+#                    artifacts + test_bench_report pin the contract in-budget
 def test_bench_tiny_smoke(monkeypatch, capsys):
     """bench.py is the driver's per-round artifact — its tiny CPU smoke must
     emit one JSON line with the contract keys (metric/value/unit/vs_baseline)."""

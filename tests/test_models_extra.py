@@ -3,10 +3,12 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.models import create_model
 
 
+@pytest.mark.slow  # ~22 s of efficientnet builds — off the tier-1 path
 def test_efficientnet_forward_and_train_mode():
     b = create_model("efficientnet-b0", 10, input_shape=(16, 16, 3))
     v = b.init(jax.random.PRNGKey(0))
@@ -17,6 +19,7 @@ def test_efficientnet_forward_and_train_mode():
     assert np.all(np.isfinite(np.asarray(logits)))
 
 
+@pytest.mark.slow  # ~13 s of efficientnet builds — off the tier-1 path
 def test_efficientnet_scaling_widths():
     b0 = create_model("efficientnet-b0", 10, input_shape=(16, 16, 3))
     b2 = create_model("efficientnet-b2", 10, input_shape=(16, 16, 3))

@@ -188,6 +188,42 @@ def test_end_to_end_packed_conv_matches_vmap_lowering(impl, conv_ds,
                                    rtol=2 * W_RTOL, atol=4 * W_ATOL)
 
 
+def test_end_to_end_auto_plan_matches_vmap_lowering(conv_ds, vmap_run):
+    """``--packed_conv auto`` (fedplan): the resolved plan MIXES lowerings
+    per stage — starved stages take the block GEMM, saturated ones the
+    grouped conv — and the mixed program is a THIRD distinct lowering with
+    its own GEMM reassociation. Params hold the uniform-lowering e2e bound
+    (0.6x margin measured); batch_stats sit one notch looser because the
+    running-var leaves are the most chaos-amplified state in the model
+    (batch-4 BN over two rounds; a single var leaf drifts ~3e-3 past the
+    uniform bound while every weight stays inside it — same reduction-
+    order noise class as the docstring above, NOT a freeze/reset bug,
+    which would blow these bounds by orders of magnitude)."""
+    from fedml_tpu.obs.plan import LoweringPlan
+    from fedml_tpu.parallel.packed import resolve_packed_conv
+
+    ds = conv_ds
+    api_off, l_off = vmap_run
+    api_on, l_on = _run_rounds(ds, _conv_cfg(packed_conv="auto"))
+    # the plan the build resolved (cached by stage shapes/K/dtype) mixes
+    # lowerings on this model — that is the scenario under test
+    bundle = create_model("resnet20", ds.class_num,
+                          input_shape=ds.train_x.shape[2:])
+    plan = resolve_packed_conv("auto", bundle, 4)
+    assert isinstance(plan, LoweringPlan)
+    assert len({s.impl for s in plan.stages}) >= 2
+    np.testing.assert_allclose(l_on, l_off, rtol=1e-2)
+    on_v, off_v = api_on.variables, api_off.variables
+    for a, b in zip(jax.tree.leaves(on_v["params"]),
+                    jax.tree.leaves(off_v["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2 * W_RTOL, atol=4 * W_ATOL)
+    for a, b in zip(jax.tree.leaves(on_v["batch_stats"]),
+                    jax.tree.leaves(off_v["batch_stats"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-1, atol=2e-2)
+
+
 def test_packed_conv_reports_prox_term_in_loss():
     """The joint form's REPORTED loss must include the FedProx proximal
     term exactly like the vmap form's batch_step does (review finding:
@@ -214,6 +250,9 @@ def test_packed_conv_reports_prox_term_in_loss():
                                rtol=1e-4)
 
 
+@pytest.mark.slow  # ~21 s: mesh twin of the sim parity pins above, which
+#                    stay in-budget (the mesh build path itself is pinned
+#                    by the cheaper crosssilo dryruns)
 def test_mesh_packed_conv_matches_vmap_lowering():
     from fedml_tpu.algorithms.fedavg import CrossSiloFedAvgAPI
     from fedml_tpu.parallel.mesh import client_mesh

@@ -177,7 +177,15 @@ def fedopt_off_run(conv_ds):
     return _run_conv(conv_ds, FedOptAPI, **FEDOPT_SGD_KW)
 
 
-@pytest.mark.parametrize("algo", ["fedopt", "fedprox", "fednova", "fedagc"])
+# fedopt rides tier-1 as the representative adaptive paradigm; the other
+# three (~10 s each) pin the same joint-vs-vmap parity on the slow lane —
+# their cheap packed-vs-sim twins in test_packed_zoo.py stay in-budget
+@pytest.mark.parametrize("algo", [
+    "fedopt",
+    pytest.param("fedprox", marks=pytest.mark.slow),
+    pytest.param("fednova", marks=pytest.mark.slow),
+    pytest.param("fedagc", marks=pytest.mark.slow),
+])
 def test_algorithm_packed_conv_matches_vmap_lowering(algo, conv_ds,
                                                      fedopt_off_run):
     """The joint MXU form vs the per-lane vmap form, per adaptive
@@ -270,6 +278,8 @@ def test_fedopt_packed_schedule_matches_plain(conv_ds, fedopt_off_run):
 
 # -- 3. the packed FedOpt round program's lane ceiling (acceptance pin) -------
 
+@pytest.mark.slow  # ~13 s: the fedavg round-program ceiling pin in
+#                    test_packed_conv.py keeps the census in-budget
 def test_packed_fedopt_round_program_ceiling():
     """ISSUE 12 acceptance: the packed (blockdiag, K=4) FedOpt flagship
     round program's flop-weighted output-lane ceiling >= 0.8 — the server

@@ -99,6 +99,8 @@ def test_packed_fedopt_server_state_persists_across_rounds():
     assert leaves and any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
 
 
+@pytest.mark.slow  # ~43 s: the heaviest zoo parity; the cheap fednova/
+#                    fedopt/robust/fedagc pins keep the mechanism in-budget
 def test_packed_fedseg_matches_sim():
     """Segmentation task family through the packed lanes (per-pixel loss /
     confusion-matrix eval) — FedSeg inherits the plain weighted mean, so

@@ -836,6 +836,7 @@ def test_fedtop_pulsetail_buffers_torn_line_until_newline(tmp_path):
 OVERHEAD_BUDGET = 0.05
 
 
+@pytest.mark.slow  # ~10 s perf-budget pin (10k-cohort plane overhead)
 def test_obs_overhead_budget_10k_cohort(tmp_path):
     """A 10k-client-cohort round with the FULL plane on — sketch lanes +
     deterministic sampled tracing + pulse stream — stays within 5% wall of

@@ -123,6 +123,17 @@ METRICS = {
                    if g else None)(
             (j.get("crossdevice") or {}).get("gateway")),
         "gw busy+shed", False),
+    # fedplan (ISSUE 18): the auto arm's chosen plan, as its summary
+    # string ("K=4 grp@32 ... pred=0.919") — a STRING column like
+    # `policy`, trajectory-only (strings never reach the drop gate).
+    # Absent on r01-r06 artifacts and on non-auto bench runs (chained
+    # .get()s return None -> "-"); an auto run that RESOLVED to a
+    # fallback embeds {"resolved","reason"} with no summary key, which
+    # renders "-" the same way.
+    "packed_plan": (
+        lambda j: ((j.get("packed_conv") or {}).get("plan") or {})
+        .get("summary"),
+        "plan", False),
     # fedsched (ISSUE 13): the cross-device block's cohort size and cohort
     # policy — context columns for the clients/s trajectory (the r06 jump
     # reads as "1000-client scheduled cohorts", not as free speed). Absent

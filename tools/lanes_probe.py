@@ -30,6 +30,18 @@ tile count, us/iteration and achieved USEFUL GFLOP/s (plus streamed for
 blockdiag — the number the MXU actually executes), for forward and
 forward+grad programs. Same whole-jitted-scan two-point protocol as the
 default mode, so tunnel dispatch cancels.
+
+Auto mode (fedplan, docs/mfu_experiments.md H10): ``--mode auto`` is the
+silicon adjudicator for the STATIC planner (obs/plan.py). It discovers
+``--model``'s real conv stages, times each stage's fwd+grad program under
+all three lowerings at K=``--lanes`` (same two-point protocol), and
+compares the planner's per-stage pick against the measured-best lowering.
+A non-dominated stage whose pick is more than ``--tolerance`` (fractional
+time, default 0.10 / PROBE_TOL) slower than the measured best is a
+DISAGREEMENT and the probe exits 1 — the H4 expansion credit the planner
+bets on (explicit fgc=K convs get lane-full mappings) is exactly what
+this mode confirms or refutes on the chip. Dominated stages (<1% of
+model conv FLOPs) are probed and reported but never gate.
 """
 
 from __future__ import annotations
@@ -219,6 +231,81 @@ def packed_main(optimizer: str = "none"):
                       "device": str(jax.devices()[0]), "rows": results}))
 
 
+def auto_main(model: str, lanes: int, tolerance: float) -> int:
+    """The H10 probe: planner pick vs measured best, per real conv stage.
+
+    Times the SAME program shape the planner scored — fwd + grad wrt
+    (activations, kernels) of one packed conv stage — so the comparison
+    is pick-vs-best on the planner's own ground. Returns a process exit
+    code: 0 agreement (within tolerance on every gating stage), 1
+    disagreement, 2 unplannable model."""
+    import jax.numpy as jnp  # noqa: F811 (module-level alias is fine)
+
+    from fedml_tpu.models import create_model
+    from fedml_tpu.obs import plan as fedplan
+    from fedml_tpu.ops import packed_conv as pc
+
+    bundle = create_model(model, 10, dtype=jnp.bfloat16,
+                          input_shape=(32, 32, 3))
+    try:
+        plan = fedplan.plan_lowering(bundle, lanes)
+    except ValueError as e:
+        print(f"fedplan cannot plan {model}: {e}", file=sys.stderr)
+        return 2
+
+    rng = np.random.RandomState(0)
+    impls = {"blockdiag": pc.conv_blockdiag, "grouped": pc.conv_grouped,
+             "off": pc.conv_vmap}
+    rows, disagreements = {}, []
+    for st in plan.stages:
+        tag = (f"{st.kh}x{st.kw}-{st.ci}-{st.co}-s{st.strides}"
+               f"@{st.h}x{st.w}")
+        xs = jnp.asarray(
+            rng.randn(lanes, BATCH, st.h, st.w, st.ci), jnp.bfloat16)
+        ws = jnp.asarray(
+            rng.randn(lanes, st.kh, st.kw, st.ci, st.co) * 0.1,
+            jnp.bfloat16)
+        us = {}
+        for name, fn in impls.items():
+            def train(a, b, f=fn, s=st.strides, p=st.padding):
+                gx, gw = jax.grad(
+                    lambda xx, ww: jnp.sum(jnp.square(
+                        f(xx, ww, s, p).astype(jnp.float32))),
+                    argnums=(0, 1))(a, b)
+                # fold the weight grad back nonlinearly so XLA cannot
+                # DCE the wgrad dot out of the timed scan
+                g = gx + (jnp.tanh(jnp.sum(gw)) * 1e-4).astype(a.dtype)
+                return (g / (jnp.max(jnp.abs(g)) + 1e-3)).astype(a.dtype)
+
+            us[name] = round(_time(_scan(train, xs, ws), xs, ws), 2)
+        best = min(us, key=us.get)
+        slower = (us[st.impl] - us[best]) / us[best] if us[best] > 0 else 0.0
+        gates = not st.dominated
+        agree = st.impl == best or slower <= tolerance
+        row = {"pick": st.impl, "measured_best": best, "us": us,
+               "pick_slower_frac": round(slower, 4),
+               "flops_frac": st.flops_frac, "dominated": st.dominated,
+               "count": st.count, "gates": gates, "agree": agree}
+        rows[tag] = row
+        print(tag, json.dumps(row), flush=True)
+        if gates and not agree:
+            disagreements.append(tag)
+
+    out = {"mode": "auto", "model": model, "lanes": lanes,
+           "tolerance": tolerance, "iters": ITERS, "batch": BATCH,
+           "device": str(jax.devices()[0]),
+           "plan": plan.summary_str(),
+           "predicted_ceiling": plan.predicted_ceiling,
+           "disagreements": disagreements, "rows": rows}
+    print(json.dumps(out))
+    if disagreements:
+        print(f"fedplan disagreement on {len(disagreements)} stage(s): "
+              f"{disagreements} — the static pick leaves "
+              f">{tolerance:.0%} on the table", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     rng = np.random.RandomState(0)
     results = {}
@@ -273,7 +360,7 @@ if __name__ == "__main__":
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--mode", choices=("lanes", "packed"),
+    ap.add_argument("--mode", choices=("lanes", "packed", "auto"),
                     default=os.environ.get("PROBE_MODE", "lanes"))
     ap.add_argument("--optimizer",
                     choices=("none", "sgd", "adam", "adamw", "adagrad",
@@ -282,8 +369,20 @@ if __name__ == "__main__":
                     help="packed mode: also time the full train step with "
                          "a per-lane stacked optax update (packed-"
                          "everywhere / H9 probe)")
+    ap.add_argument("--model",
+                    default=os.environ.get("BENCH_MODEL", "resnet56"),
+                    help="auto mode: whose conv stages to adjudicate")
+    ap.add_argument("--lanes", type=int,
+                    default=int(os.environ.get("PROBE_LANES", "4")),
+                    help="auto mode: pack-lane count K")
+    ap.add_argument("--tolerance", type=float,
+                    default=float(os.environ.get("PROBE_TOL", "0.10")),
+                    help="auto mode: fractional pick-vs-best slowdown "
+                         "above which a non-dominated stage fails")
     args = ap.parse_args()
-    if args.mode == "packed":
+    if args.mode == "auto":
+        sys.exit(auto_main(args.model, args.lanes, args.tolerance))
+    elif args.mode == "packed":
         packed_main(args.optimizer)
     else:
         main()
