@@ -52,6 +52,18 @@ MSG_TYPE_WIRE_BUSY = "__wire_busy__"  # fedlint: disable=protocol-exhaustiveness
 # (acks). The gateway routes by (tenant, rank) into per-tenant lanes;
 # handlers never read it, and a tenant-less federation never stamps it.
 MSG_ARG_KEY_TENANT = "__tenant__"
+# fedflight cross-rank capture (obs/flight.py, DESIGN.md §21): when a
+# flight trigger fires on the server (watchdog escalation, quarantine),
+# it broadcasts FLIGHT_DUMP to every worker BEFORE re-raising, carrying
+# the deterministic incident id + rule + round, so every rank flushes its
+# full-rate flight ring into the SAME incident-<id>/ bundle. Each send is
+# fire-and-forget (no acks awaited — a dead peer bounds the flush at the
+# transport's send deadline instead of hanging teardown); the client
+# managers register a handler that routes to obs.flight.handle_dump_message.
+MSG_TYPE_FLIGHT_DUMP = "__flight_dump__"
+MSG_ARG_KEY_FLIGHT_ID = "__flight_id__"
+MSG_ARG_KEY_FLIGHT_RULE = "__flight_rule__"
+MSG_ARG_KEY_FLIGHT_ROUND = "__flight_round__"
 # Trace context (fedml_tpu/obs, DESIGN.md §12): (trace id, parent span id,
 # message uid), stamped by the traced send in comm/managers.py and read
 # back at dispatch so a recv span links to the send span that caused it —

@@ -250,6 +250,28 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                     self._cv.wait(timeout=wait)
                     continue
             for p in gave_up:
+                # fedflight peer_dead trigger (obs/flight.py): a message
+                # just exhausted its full retry budget — dump the incident
+                # bundle while the recent rounds are still in the rings.
+                # Off-lock (bundle IO must not stall acks/retransmits) and
+                # fully guarded: a recorder failure must never take down
+                # the retransmit thread. No-op while the recorder is off
+                # or the peer_dead trigger is not armed.
+                try:
+                    from fedml_tpu.obs import flight as _flight
+
+                    rec = _flight.recorder_if_enabled()
+                    if rec is not None:
+                        rec.trigger(
+                            "peer_dead",
+                            int(p.msg.get("round_idx", 0) or 0),
+                            kind="peer_dead",
+                            reason=(f"rank {self.rank}: peer {p.receiver} "
+                                    f"unacked after {self.retry_max} "
+                                    "retries"))
+                except Exception:
+                    LOG.exception("rank %d: flight peer_dead dump failed",
+                                  self.rank)
                 cb = self.on_gave_up
                 if cb is not None:
                     try:

@@ -373,6 +373,30 @@ class FedConfig:
     # escalate-to-raise: any critical health event raises
     # FederationHealthError AFTER its pulse snapshot is written
     health_escalate: bool = False
+    # fedflight anomaly-triggered flight recorder (obs/flight, DESIGN.md
+    # §21): when set, the process retains the last --flight_window rounds
+    # of FULL-rate round spans (a second per-rank ring beside the sampled
+    # trace stream — the head sampler keeps gating what streams, the
+    # recorder keeps everything recent), pulse snapshots with per-round
+    # counter-lane deltas, and watchdog transitions — and dumps a
+    # self-contained incident-<id>/ bundle into this directory when a
+    # trigger fires (watchdog escalation BEFORE the raise, gateway
+    # quarantine, reliable-layer peer_dead, manual/SIGUSR2). The bundle
+    # manifest names the EXACT replay command from (seed, chaos_seed,
+    # non-default flags); incident ids are pure in (seed, round, rule) so
+    # every rank converges on one bundle; analyze with tools/fedpost.py.
+    # None (default) disarms the recorder: hot paths see one attribute
+    # check and allocate nothing, and a recorder-on run is bit-identical
+    # to a recorder-off run (the recorder only reads what the round
+    # already produced).
+    flight_dir: Optional[str] = None
+    # rounds of full-rate retrospective capture retained per rank
+    # (ring bound = flight_window * obs.flight.EVENTS_PER_ROUND events)
+    flight_window: int = 8
+    # comma list arming the trigger inventory: escalate (watchdog),
+    # quarantine (gateway lane), peer_dead (reliable layer), manual
+    # (obs.flight.trigger() / SIGUSR2)
+    flight_on: str = "escalate,quarantine,peer_dead,manual"
     # fedscope device-memory sampler: when tracing is on, snapshot
     # jax.local_devices() memory_stats (bytes_in_use + peak watermark) at
     # every round boundary into a "device" counter lane (one allocator read
@@ -502,6 +526,17 @@ class FedConfig:
         if self.health_skew < 0:
             raise ValueError(
                 f"health_skew must be >= 0, got {self.health_skew}")
+        if self.flight_window < 1:
+            raise ValueError(
+                f"flight_window must be >= 1, got {self.flight_window}")
+        _flight_allowed = {"escalate", "quarantine", "peer_dead", "manual"}
+        _flight_toks = {t.strip() for t in (self.flight_on or "").split(",")
+                        if t.strip()}
+        if _flight_toks - _flight_allowed:
+            raise ValueError(
+                f"flight_on has unknown trigger(s) "
+                f"{sorted(_flight_toks - _flight_allowed)}; allowed: "
+                f"{sorted(_flight_allowed)}")
         if self.checkpoint_frequency < 1:
             raise ValueError(
                 f"checkpoint_frequency must be >= 1, got {self.checkpoint_frequency}"
@@ -795,6 +830,20 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                    default=defaults.health_escalate,
                    help="raise FederationHealthError on critical health "
                         "events (0|1; snapshot is written first)")
+    p.add_argument("--flight_dir", type=str, default=None,
+                   help="fedflight black-box recorder: retain the last "
+                        "--flight_window rounds at FULL rate and dump a "
+                        "self-contained incident-<id>/ bundle here on "
+                        "trigger (watchdog escalation before the raise, "
+                        "gateway quarantine, peer_dead, SIGUSR2); analyze "
+                        "with tools/fedpost.py (None = recorder off)")
+    p.add_argument("--flight_window", type=int,
+                   default=defaults.flight_window,
+                   help="rounds of full-rate retrospective capture the "
+                        "flight recorder retains per rank")
+    p.add_argument("--flight_on", type=str, default=defaults.flight_on,
+                   help="comma list arming flight triggers: escalate, "
+                        "quarantine, peer_dead, manual")
     p.add_argument("--trace_device_sampler", type=lambda s: bool(int(s)),
                    default=defaults.trace_device_sampler,
                    help="sample per-device memory at round boundaries into "

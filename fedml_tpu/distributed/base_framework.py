@@ -41,6 +41,39 @@ MSG_TYPE_LOCAL_ROUND_DEADLINE = 99
 MAX_EMPTY_DEADLINES = 10
 
 
+def broadcast_flight_dump(manager, size: int) -> None:
+    """fedflight cross-rank capture (obs/flight.py, DESIGN.md §21): when a
+    server-side trigger just dumped an incident bundle (the pulse plane
+    dumps BEFORE the watchdog's escalate raise), tell every worker rank to
+    flush its own full-rate flight ring into the SAME deterministic
+    incident id. Fire-and-forget with a bounded flush deadline: each send
+    is individually try/excepted and no acks are awaited, so a dead peer
+    costs at most the transport's send timeout instead of hanging the
+    dying server's teardown. No-op while the recorder is off or nothing
+    has triggered."""
+    from fedml_tpu.comm.message import (
+        MSG_ARG_KEY_FLIGHT_ID,
+        MSG_ARG_KEY_FLIGHT_ROUND,
+        MSG_ARG_KEY_FLIGHT_RULE,
+        MSG_TYPE_FLIGHT_DUMP,
+    )
+    from fedml_tpu.obs import flight as _flight
+
+    info = _flight.last_incident()
+    if info is None:
+        return
+    for rank in range(1, int(size)):
+        try:
+            m = Message(MSG_TYPE_FLIGHT_DUMP, manager.rank, rank)
+            m.add_params(MSG_ARG_KEY_FLIGHT_ID, info["id"])
+            m.add_params(MSG_ARG_KEY_FLIGHT_RULE, info["rule"])
+            m.add_params(MSG_ARG_KEY_FLIGHT_ROUND, info["round"])
+            manager.send_message(m)
+        except Exception as e:
+            logging.getLogger("fedflight").warning(
+                "flight dump broadcast to rank %d failed (%s)", rank, e)
+
+
 def require_injectable(comm, feature: str = "straggler_deadline_sec") -> None:
     # asks the manager itself (not its type): wire middleware wrappers
     # (reliable/chaos) delegate the answer to the transport they wrap

@@ -52,8 +52,10 @@ from fedml_tpu.distributed.base_framework import (  # noqa: E402
     MAX_EMPTY_DEADLINES,
     MSG_TYPE_LOCAL_ROUND_DEADLINE,
     RoundDeadlineTimer,
+    broadcast_flight_dump,
     require_injectable,
 )
+from fedml_tpu.comm.message import MSG_TYPE_FLIGHT_DUMP  # noqa: E402
 # Round tag: syncs carry the server's round index; uploads echo it so the
 # server can drop stale uploads from workers that fell behind and rejoined.
 MSG_ARG_KEY_ROUND = "round_idx"
@@ -642,14 +644,23 @@ class FedAvgEdgeServerManager(ServerManager):
             # aggregated, so the stream records the dying state.
             # stale_uploads is NOT in extra: it rides the registry wire
             # lane live (the watchdog's stale_spike delta reads it there)
-            pulse.on_round(
-                self.round_idx, source="edge_server",
-                loss=(float(metrics["loss"]) if metrics
-                      and metrics.get("loss") is not None else None),
-                round_ms=(time.perf_counter() - self._round_t0) * 1e3,
-                extra={"uploads": uploads,
-                       "workers_alive": sum(
-                           1 for a in self._alive.values() if a)})
+            try:
+                pulse.on_round(
+                    self.round_idx, source="edge_server",
+                    loss=(float(metrics["loss"]) if metrics
+                          and metrics.get("loss") is not None else None),
+                    round_ms=(time.perf_counter() - self._round_t0) * 1e3,
+                    extra={"uploads": uploads,
+                           "workers_alive": sum(
+                               1 for a in self._alive.values() if a)})
+            except Exception:
+                # fedflight cross-rank capture: the escalating plane just
+                # dumped the server's incident bundle (dump-before-raise,
+                # obs/live.py) — tell every worker to flush its own flight
+                # ring to the same incident id BEFORE the error propagates
+                # and tears the federation down
+                broadcast_flight_dump(self, self.size)
+                raise
         self.round_idx += 1
         self._maybe_checkpoint()
         if self.round_idx >= self.round_num:
@@ -775,6 +786,15 @@ class FedAvgEdgeClientManager(ClientManager):
             MSG_TYPE_S2C_SYNC_MODEL, self.handle_message_receive_model_from_server
         )
         self.register_message_receive_handler(MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+        self.register_message_receive_handler(MSG_TYPE_FLIGHT_DUMP, self.handle_message_flight_dump)
+
+    def handle_message_flight_dump(self, msg: Message) -> None:
+        """Server-broadcast incident capture: flush this rank's flight ring
+        into the broadcast incident id's bundle (idempotent; no-op while
+        the recorder is off)."""
+        from fedml_tpu.obs import flight as _flight
+
+        _flight.handle_dump_message(msg.get_params(), rank=self.rank)
 
     def handle_message_init(self, msg: Message):
         self.round_idx = 0

@@ -408,19 +408,31 @@ class FedBuffEdgeServerManager(ServerManager):
             # the wire lane; version lag feeds the staleness sketch per
             # fold (observe_upload), so the watchdog's version_lag rule
             # reads this round's delta p99.
-            pulse.on_round(
-                v_idx, source="fedbuff_server",
-                loss=(float(metrics["loss"]) if metrics
-                      and metrics.get("loss") is not None else None),
-                round_ms=(time.perf_counter() - self._emit_t0) * 1e3,
-                # dispatch-thread-only read; emit() is the sole writer and
-                # runs on this same thread (see _send_assignment above)
-                # fedlint: disable=check-then-act
-                extra={"server_version": self.buffer.version,
-                       "uploads": rec["folds"],
-                       "version_lag_max": rec["staleness_max"],
-                       "workers_alive": sum(
-                           1 for a in self._alive.values() if a)})
+            try:
+                pulse.on_round(
+                    v_idx, source="fedbuff_server",
+                    loss=(float(metrics["loss"]) if metrics
+                          and metrics.get("loss") is not None else None),
+                    round_ms=(time.perf_counter() - self._emit_t0) * 1e3,
+                    # dispatch-thread-only read; emit() is the sole writer
+                    # and runs on this same thread (_send_assignment above)
+                    # fedlint: disable=check-then-act
+                    extra={"server_version": self.buffer.version,
+                           "uploads": rec["folds"],
+                           "version_lag_max": rec["staleness_max"],
+                           "workers_alive": sum(
+                               1 for a in self._alive.values() if a)})
+            except Exception:
+                # fedflight: the escalating plane just dumped this rank's
+                # incident bundle (dump-before-raise, obs/live.py) —
+                # broadcast the dump so every worker flushes its flight
+                # ring to the same incident id before the error unwinds
+                from fedml_tpu.distributed.base_framework import (
+                    broadcast_flight_dump,
+                )
+
+                broadcast_flight_dump(self, self.size)
+                raise
         self._emit_t0 = time.perf_counter()
         if self.buffer.versions_emitted >= self.versions_total:
             self._teardown()
@@ -626,6 +638,18 @@ class FedBuffEdgeClientManager(ClientManager):
             MSG_TYPE_S2C_SYNC_MODEL, self.handle_assignment)
         self.register_message_receive_handler(
             MSG_TYPE_S2C_FINISH, self.handle_finish)
+        from fedml_tpu.comm.message import MSG_TYPE_FLIGHT_DUMP
+
+        self.register_message_receive_handler(
+            MSG_TYPE_FLIGHT_DUMP, self.handle_flight_dump)
+
+    def handle_flight_dump(self, msg: Message) -> None:
+        """Server-broadcast incident capture (obs/flight.py): flush this
+        rank's flight ring into the broadcast incident id's bundle
+        (idempotent; no-op while the recorder is off)."""
+        from fedml_tpu.obs import flight as _flight
+
+        _flight.handle_dump_message(msg.get_params(), rank=self.rank)
 
     def _send_join(self) -> None:
         if self._done:

@@ -125,6 +125,10 @@ class TenantLane:
         watchdog.baseline(self.registry.snapshot("wire"))
         self.plane = PulsePlane(exporter=exporter, profiler=profiler,
                                 watchdog=watchdog, registry=self.registry)
+        # fedflight tenant scoping: the recorder keys this lane's round
+        # window (and any quarantine bundle) to the tenant id, so one
+        # tenant's incident never interleaves another's rounds
+        self.plane.tenant = self.tenant
         self.aggregator = None
         self.comm: Optional[BaseCommunicationManager] = None
 
@@ -427,10 +431,22 @@ def run_gateway(tenants, transport: str = "local", timeout: float = 300.0,
                     mgr.tenant = lane.tenant
                     mgr.run()
             except FederationHealthError as e:
+                # the lane's escalating plane already dumped the tenant-
+                # scoped flight bundle (dump-before-raise, obs/live.py)
                 lane.error = str(e)
                 mux.quarantine(lane.tenant, str(e))
             except BaseException as e:
                 lane.error = repr(e)
+                # fedflight: a non-health crash skipped the plane's dump
+                # hook — capture the tenant's window under the quarantine
+                # trigger before the lane state is torn down
+                try:
+                    from fedml_tpu.obs import flight as _flight
+
+                    _flight.trigger("lane_crash", 0, kind="quarantine",
+                                    reason=repr(e), tenant=lane.tenant)
+                except Exception:
+                    pass
                 mux.quarantine(lane.tenant, f"lane crashed: {e!r}")
             finally:
                 if comm is not None:

@@ -46,6 +46,15 @@ assumes (arXiv:2303.01778):
   (``--trace_sample_rate``, a pure function of (seed, round, id)) so
   thousand-client cohorts keep bounded spans while sampled-out rounds
   still feed every sketch.
+- :mod:`fedml_tpu.obs.flight` (fedflight, DESIGN.md §21) — the black-box
+  recorder: while ``--flight_dir`` is armed, a second per-rank FULL-rate
+  span ring (sampled-out rounds included, via a shadow tracer), per-scope
+  pulse-snapshot windows and watchdog transitions are retained for the
+  last ``--flight_window`` rounds; watchdog escalation (dump BEFORE the
+  raise), gateway quarantine, peer death, or SIGUSR2 dumps a
+  self-contained ``incident-<id>/`` bundle whose id is pure in
+  ``(seed, round, rule)`` — every rank converges on one bundle with no
+  coordination. ``tools/fedpost.py`` renders the postmortem verdict.
 
 Tracing is OFF by default and enabled per run via ``--trace_dir``
 (core/config.py); the pulse plane likewise via ``--pulse_path``. The
@@ -63,6 +72,12 @@ from fedml_tpu.obs.cost import (
     reset_cost_tables,
 )
 from fedml_tpu.obs.device import sample_device_memory
+from fedml_tpu.obs.flight import (
+    FlightRecorder,
+    flight_enabled,
+    incident_id,
+    recorder_if_enabled,
+)
 from fedml_tpu.obs.health import FederationHealthError, HealthWatchdog
 from fedml_tpu.obs.live import (
     LiveExporter,
@@ -98,6 +113,7 @@ __all__ = [
     "ClientProfiler",
     "CounterGroup",
     "FederationHealthError",
+    "FlightRecorder",
     "HealthWatchdog",
     "LiveExporter",
     "MetricsRegistry",
@@ -111,7 +127,9 @@ __all__ = [
     "cost_tables",
     "default_registry",
     "enable_cost_attribution",
+    "flight_enabled",
     "fwd_flops_per_image",
+    "incident_id",
     "merge_all",
     "peak_flops",
     "reset_cost_tables",
@@ -121,6 +139,7 @@ __all__ = [
     "pulse_enabled",
     "pulse_if_enabled",
     "record_cache_hit",
+    "recorder_if_enabled",
     "registry_scope",
     "reset",
     "sample_device_memory",

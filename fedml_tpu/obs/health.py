@@ -117,6 +117,11 @@ class HealthWatchdog:
         #: bounded event history (a weeks-long run keeps the latest N)
         self.events: deque = deque(maxlen=int(history))
         self._prev_wire: dict = {}
+        #: the run-start wire counters (the :meth:`baseline` snapshot) —
+        #: kept separately from the rolling ``_prev_wire`` so
+        #: :meth:`incident` can report whole-run deltas, not just the
+        #: last round's
+        self._baseline: dict = {}
         #: delta baseline for the profiles_dropped rule
         self._prev_dropped = 0
 
@@ -131,6 +136,7 @@ class HealthWatchdog:
         for k, v in (wire or {}).items():
             if isinstance(v, (int, float)):
                 self._prev_wire[k] = int(v)
+        self._baseline = dict(self._prev_wire)
 
     def check_round(self, round_idx: int, *, loss: Optional[float] = None,
                     round_ms: Optional[float] = None,
@@ -228,6 +234,28 @@ class HealthWatchdog:
                     default=_SEVERITY["ok"])
         self.state = _STATES[max(worst, _SEVERITY[self.state])]
         return events
+
+    def incident(self) -> Optional[dict]:
+        """Structured view of the watchdog's current incident — the ONE
+        API the flight recorder, fedtop and fedpost consume instead of
+        re-parsing pulse snapshots: the rule that fired (the most recent
+        critical event, falling back to the most recent event of any
+        severity), its round and detail, the sticky worst state, the
+        whole-run wire-counter deltas vs the :meth:`baseline` snapshot,
+        and the recent event tail. None while no rule has ever fired."""
+        crit = [e for e in self.events if e["severity"] == "critical"]
+        ev = crit[-1] if crit else (self.events[-1] if self.events else None)
+        if ev is None:
+            return None
+        deltas = {}
+        for k in sorted(self._prev_wire):
+            d = self._prev_wire[k] - self._baseline.get(k, 0)
+            if d:
+                deltas[k] = d
+        return {"rule": ev["rule"], "round": ev["round"],
+                "severity": ev["severity"], "detail": ev["detail"],
+                "state": self.state, "baseline_deltas": deltas,
+                "events": list(self.events)[-16:]}
 
     def maybe_escalate(self, events: list) -> None:
         """Escalate-to-raise mode: die loudly on this round's critical

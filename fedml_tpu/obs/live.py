@@ -59,6 +59,8 @@ from fedml_tpu.obs.profile import ClientProfiler
 from fedml_tpu.obs.registry import default_registry
 from fedml_tpu.obs.tracer import tracer_if_enabled
 
+from fedml_tpu.obs.flight import recorder_if_enabled as _flight_recorder
+
 __all__ = [
     "FederationHealthError", "LiveExporter", "PulsePlane", "configure",
     "configure_from", "plane_scope", "pulse_enabled", "pulse_if_enabled",
@@ -184,6 +186,11 @@ class PulsePlane:
         #: that tenant's registry so its snapshots can never pick up another
         #: tenant's counters, whichever thread emits the round.
         self.registry = registry
+        #: fedflight scope tag: the gateway pins each lane's plane to its
+        #: tenant id so the flight recorder keys that lane's round window
+        #: (and any quarantine bundle) to the tenant, never interleaving
+        #: another tenant's rounds. None = the default federation scope.
+        self.tenant: Optional[str] = None
         self._t_last_ms: Optional[float] = None
         self._round_clients = 0
         self._peak = None
@@ -366,6 +373,14 @@ class PulsePlane:
                 "cost": self._cost(round_ms), "health": health}
         if self.exporter is not None:
             self.exporter.emit(snap)
+        # fedflight: retain the round in the recorder's window AND — when
+        # this round's criticals are about to escalate below — dump the
+        # incident bundle BEFORE maybe_escalate raises, so the bundle
+        # exists by the time FederationHealthError propagates
+        rec = _flight_recorder()
+        if rec is not None:
+            rec.record_round(snap, watchdog=self.watchdog,
+                             tenant=self.tenant, events=events)
         if self.watchdog is not None:
             self.watchdog.maybe_escalate(events)
         return snap

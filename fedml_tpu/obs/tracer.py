@@ -148,6 +148,14 @@ class Tracer:
         self._open: dict = {}
         self._open_lock = threading.Lock()
         self._jax_bridge = None
+        #: fedflight full-rate retrospective ring (obs/flight.py): when the
+        #: flight recorder is armed, every event ALSO lands here — the head
+        #: sampler keeps gating what streams, the recorder keeps everything
+        #: recent. None (the default) costs one attribute check per emit.
+        self._flight_ring = None
+        #: lazily-built shadow tracer for sampled-OUT rounds while the
+        #: recorder is armed (tracer_if_sampled)
+        self._flight_shadow = None
 
     # -- internals ---------------------------------------------------------
     def _next_id(self) -> int:
@@ -160,8 +168,8 @@ class Tracer:
             s = self._tls.stack = []
         return s
 
-    def _emit(self, ph: str, name: str, cat: str, ts_us: int, dur_us,
-              span_id, parent_id, args) -> None:
+    def _make_ev(self, ph: str, name: str, cat: str, ts_us: int, dur_us,
+                 span_id, parent_id, args) -> dict:
         ev = {"ph": ph, "name": name, "cat": cat, "ts": ts_us,
               "rank": self.rank, "tid": threading.get_ident() & 0xFFFF}
         if self.process:
@@ -176,7 +184,16 @@ class Tracer:
             ev["psid"] = parent_id
         if args:
             ev["args"] = args
+        return ev
+
+    def _emit(self, ph: str, name: str, cat: str, ts_us: int, dur_us,
+              span_id, parent_id, args) -> None:
+        ev = self._make_ev(ph, name, cat, ts_us, dur_us, span_id,
+                           parent_id, args)
         self._ring.append(ev)
+        fr = self._flight_ring
+        if fr is not None:
+            fr.append(ev)
 
     # -- public API --------------------------------------------------------
     def span(self, name: str, cat: str = "app", args: Optional[dict] = None,
@@ -299,6 +316,35 @@ class Tracer:
         return len(events) + len(extra)
 
 
+class _FlightShadowTracer(Tracer):
+    """Handed out by :func:`tracer_if_sampled` for sampled-OUT rounds while
+    the flight recorder is armed: the full public span API, but every
+    event lands ONLY in the parent tracer's flight ring — the streamed
+    trace keeps the head sampler's reproducible subset while the recorder
+    retains everything recent. Span ids come from the parent's counter so
+    an incident's merged ring never collides ids with streamed spans of
+    neighboring rounds. Cached per parent (``_flight_shadow``), so a
+    round's begin_span/end_span pair lands on one ``_open`` table even
+    when the two calls re-derive the tracer in different handlers."""
+
+    def __init__(self, parent: Tracer):
+        super().__init__(rank=parent.rank, buffer_events=1,
+                         trace_id=parent.trace_id, process=parent.process)
+        self._parent = parent
+        self._jax_bridge = parent._jax_bridge
+
+    def _next_id(self) -> int:
+        return self._parent._next_id()
+
+    def _emit(self, ph, name, cat, ts_us, dur_us, span_id, parent_id,
+              args) -> None:
+        fr = self._parent._flight_ring
+        if fr is None:
+            return
+        fr.append(self._make_ev(ph, name, cat, ts_us, dur_us, span_id,
+                                parent_id, args))
+
+
 class _DisabledTracer(Tracer):
     """Shared no-op tracer handed out while tracing is off; every public
     entry point early-returns on ``enabled`` before touching state."""
@@ -323,6 +369,24 @@ _JAX_BRIDGE = False
 #: hashes (defaults = keep everything, the pre-fedsketch behavior)
 _SAMPLE_RATE = 1.0
 _SAMPLE_SEED = 0
+#: fedflight hook (obs/flight.py): ``recorder.ring_for`` while the flight
+#: recorder is armed — get_tracer attaches the per-(process, rank) flight
+#: ring at tracer creation; None (the default) keeps the hot path at one
+#: attribute check per emit
+_FLIGHT_RING_FACTORY = None
+
+
+def set_flight_ring_factory(factory) -> None:
+    """Install (or, with None, remove) the flight-ring factory and
+    re-attach/detach the ring on every LIVE tracer — called by
+    ``obs.flight.configure`` so a recorder armed mid-process still
+    captures ranks that started tracing earlier."""
+    global _FLIGHT_RING_FACTORY
+    with _lock:
+        _FLIGHT_RING_FACTORY = factory
+        for tr in _TRACERS.values():
+            tr._flight_ring = (None if factory is None
+                               else factory(tr.rank, tr.process))
 
 _M64 = (1 << 64) - 1
 
@@ -424,14 +488,17 @@ def configure_from(config) -> bool:
     DISABLES tracing left on by an earlier run in the same process (its
     events would otherwise append into the previous run's trace files).
     Only a config without the attribute at all leaves tracing untouched."""
-    # fedcost and fedpulse ride the same entry-point hook: a config
-    # carrying cost_attribution / pulse_path configures static roofline
-    # attribution and the live telemetry plane here too
+    # fedcost, fedpulse and fedflight ride the same entry-point hook: a
+    # config carrying cost_attribution / pulse_path / flight_dir configures
+    # static roofline attribution, the live telemetry plane and the flight
+    # recorder here too
     from fedml_tpu.obs import cost as _cost
+    from fedml_tpu.obs import flight as _flight
     from fedml_tpu.obs import live as _live
 
     _cost.configure_from(config)
     _live.configure_from(config)
+    _flight.configure_from(config)
     trace_dir = getattr(config, "trace_dir", _NO_TRACE_DIR)
     if trace_dir is _NO_TRACE_DIR:
         return tracing_enabled()
@@ -465,6 +532,8 @@ def get_tracer(rank: int = 0) -> Tracer:
             tr = _TRACERS[rank] = Tracer(rank, buffer_events=_BUFFER,
                                          trace_id=_TRACE_ID,
                                          process=_process_index())
+            if _FLIGHT_RING_FACTORY is not None:
+                tr._flight_ring = _FLIGHT_RING_FACTORY(tr.rank, tr.process)
             if _JAX_BRIDGE:
                 try:
                     import jax
@@ -493,7 +562,22 @@ def tracer_if_sampled(rank: int = 0, round_idx: int = 0) -> Optional[Tracer]:
     if not _ENABLED:
         return None
     if _SAMPLE_RATE < 1.0 and not span_sampled(round_idx):
-        return None
+        # fedflight retroactive capture: while the recorder is armed the
+        # sampled-out round still emits — through a shadow tracer whose
+        # events land ONLY in the flight ring, never in the stream
+        # benign racy read of the arm gate: the factory is installed at
+        # configure time before federations start; the worst a torn read
+        # costs is one sampled-out round missing from a recorder armed
+        # mid-run, never a wrong event  # fedlint: disable=check-then-act
+        if _FLIGHT_RING_FACTORY is None:
+            return None
+        tr = get_tracer(rank)
+        if tr._flight_ring is None:
+            return None
+        shadow = tr._flight_shadow
+        if shadow is None:
+            shadow = tr._flight_shadow = _FlightShadowTracer(tr)
+        return shadow
     return get_tracer(rank)
 
 
@@ -544,9 +628,11 @@ def reset() -> None:
         _SAMPLE_RATE = 1.0
         _SAMPLE_SEED = 0
         _TRACERS.clear()
+    from fedml_tpu.obs import flight as _flight
     from fedml_tpu.obs import live as _live
 
     _live.reset()
+    _flight.reset()
     import sys
 
     packed = sys.modules.get("fedml_tpu.parallel.packed")

@@ -150,3 +150,17 @@ def pytest_sessionfinish(session, exitstatus):
                  f"{st['overhead_budget_pct']:g}%)")
     except Exception:
         pass
+    # fedflight session digest: always emitted — a green run expects 0
+    # incident bundles from tests that did not mean to trigger one (the
+    # flight tests use tmp_path recorders and DO count here; their
+    # expected dumps are part of the number, so a drift either way is a
+    # behavior change worth seeing in the tier-1 log)
+    try:
+        from fedml_tpu.obs.flight import session_stats as flight_stats
+
+        st = flight_stats()
+        emit(f"[t1] incidents: {st['incidents']} bundle(s) dumped this "
+             f"session" + (f", last {st['last_bundle']}"
+                           if st["last_bundle"] else ""))
+    except Exception:
+        pass

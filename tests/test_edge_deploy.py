@@ -112,6 +112,7 @@ fe.run_fedavg_edge_rank(_load(cfg), cfg)
 """
 
 
+@pytest.mark.slow  # ~19 s: grpc twin of the local worker-crash pins
 def test_grpc_worker_killed_mid_round_server_completes(tmp_path):
     """VERDICT r3 weak #1: the edge star protocol must survive a dead worker
     over a REAL transport. Rank 2's OS process dies (os._exit, port and all)

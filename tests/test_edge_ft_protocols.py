@@ -56,6 +56,7 @@ class TestTAThreshold:
                                        rtol=0, atol=1e-6)
         assert ft.history["Test/Acc"] == strict.history["Test/Acc"]
 
+    @pytest.mark.slow  # ~11 s: dead-from-start + healthy-ring pins stay in-tier
     def test_threshold_recovery_clients_die_between_phases(self, monkeypatch):
         """THE threshold property: two of four clients deal their shares
         then die before REVEAL. The server reconstructs from the remaining
@@ -169,6 +170,7 @@ class TestSplitNNManagedRing:
         assert len(server.val_history) == 4
         assert server.ring_alive == {1: True, 2: False, 3: True}
 
+    @pytest.mark.slow  # ~7 s: grpc twin of the local skip-and-re-form pin
     def test_silent_client_skipped_over_grpc(self, monkeypatch):
         """The same skip-and-re-form over real gRPC sockets."""
         pytest.importorskip("grpc")

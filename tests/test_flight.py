@@ -1,0 +1,376 @@
+"""fedflight (obs/flight + tools/fedpost): the anomaly-triggered flight
+recorder, incident bundles, and the postmortem analyzer (ISSUE 19
+acceptance surface).
+
+Pinned contracts:
+- a seeded-chaos escalation (local AND grpc transports) writes ONE
+  self-contained ``incident-<id>/`` bundle — manifest last, per-rank
+  full-rate ring dumps despite ``--trace_sample_rate 0`` — BEFORE the
+  FederationHealthError propagates, with the id pure in
+  ``(seed, round, rule)``;
+- ``tools/fedpost.py`` renders a verdict from the bundle alone (golden
+  over a committed fixture, text and ``--markdown``), exits 1 on a
+  malformed/incomplete bundle;
+- ``trace_report --incident`` and the fedtop INCIDENT banner read the
+  same bundle; a stream without bundles renders byte-identically;
+- a recorder-on run is bit-identical to recorder-off and dumps nothing
+  when healthy;
+- the disabled path allocates nothing (one module-global read);
+- a gateway quarantine dumps a TENANT-scOPED bundle while the healthy
+  tenant still computes the standalone run's exact weights.
+""".replace("scOPED", "scoped")
+
+import gc
+import glob
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu import obs
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data import load_dataset
+from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+from fedml_tpu.obs import flight
+from fedml_tpu.obs.health import FederationHealthError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "flight")
+FIXTURE_BUNDLE = os.path.join(FIXTURES, "incident-00decafc0ffee123")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs():
+    """The recorder is process-global (obs.reset() chains flight.reset());
+    the teardown gc drains finished federations' observer cycles, the
+    test_pulse precedent."""
+    obs.reset()
+    yield
+    obs.reset()
+    from fedml_tpu.obs import default_registry
+
+    if default_registry().snapshot("wire") or default_registry().snapshot("chaos"):
+        gc.collect()
+
+
+def _edge_cfg(**kw):
+    base = dict(
+        model="lr", dataset="synthetic_1_1", client_num_in_total=4,
+        client_num_per_round=4, comm_round=2, batch_size=10, lr=0.1,
+        epochs=1, frequency_of_the_test=1, seed=3, device_data="off",
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _edge_ds():
+    return load_dataset("synthetic_1_1", num_clients=4, batch_size=10, seed=3)
+
+
+def _escalate_cfg(tmp_path, **kw):
+    """The seeded-chaos escalation recipe (test_pulse's) with the recorder
+    armed and the head sampler set to DROP every round — the flight rings
+    must capture the incident rounds anyway (retroactive full-rate)."""
+    return _edge_cfg(
+        pulse_path=str(tmp_path / "pulse.jsonl"),
+        trace_dir=str(tmp_path), trace_sample_rate=0.0,
+        flight_dir=str(tmp_path), flight_window=4,
+        chaos_delay_ms=5.0, chaos_seed=7,
+        health_stall_sec=0.001, health_escalate=True, **kw)
+
+
+def _bundles(root):
+    return sorted(glob.glob(os.path.join(str(root), "incident-*")))
+
+
+def _assert_complete_escalation_bundle(tmp_path, ranks=3):
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1, bundles
+    bundle = bundles[0]
+    # the id is pure in (seed, round, rule): every rank — and this test —
+    # derives the same name with no coordination
+    assert os.path.basename(bundle) == \
+        f"incident-{obs.incident_id(3, 0, 'round_stall')}"
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        man = json.load(f)
+    assert man["rule"] == "round_stall" and man["kind"] == "escalate"
+    assert man["seed"] == 3 and man["chaos_seed"] == 7
+    assert man["replay_cmd"].startswith("python -m fedml_tpu.experiments.run")
+    assert man["replay_cmd"].endswith("--seed 3 --chaos_seed 7")
+    assert "--health_escalate 1" in man["replay_cmd"]
+    # every rank's ring dumped, and despite trace_sample_rate=0.0 each ring
+    # holds real span events (the shadow tracer's full-rate capture)
+    for r in range(ranks):
+        ring = os.path.join(bundle, f"ring-rank{r}.jsonl")
+        assert os.path.exists(ring), f"missing ring for rank {r}"
+        events = [json.loads(l) for l in open(ring) if l.strip()]
+        assert events, f"rank {r} ring is empty"
+    for name in ("trace-merged.jsonl", "pulse-tail.jsonl", "rounds.jsonl",
+                 "watchdog.json"):
+        assert name in man["files"] and os.path.exists(
+            os.path.join(bundle, name))
+    return bundle
+
+
+# -- the tentpole: dump-before-raise on escalation, local and grpc ----------
+
+@pytest.mark.chaos
+def test_flight_escalation_local_bundle_then_fedpost_and_trace_report(
+        tmp_path, capsys):
+    """Local transport: the escalating run leaves a complete bundle on
+    disk BEFORE FederationHealthError propagates, and both analyzers
+    render it from the directory alone."""
+    with pytest.raises(RuntimeError) as exc:
+        run_fedavg_edge(_edge_ds(), _escalate_cfg(tmp_path), worker_num=2)
+    assert isinstance(exc.value.__cause__, FederationHealthError)
+    bundle = _assert_complete_escalation_bundle(tmp_path)
+
+    fedpost = _load_tool("fedpost")
+    assert fedpost.main([bundle]) == 0
+    out = capsys.readouterr().out
+    assert f"incident {obs.incident_id(3, 0, 'round_stall')}" in out
+    assert "round_stall" in out and "replay:" in out
+    assert "--seed 3 --chaos_seed 7" in out
+
+    trace_report = _load_tool("trace_report")
+    assert trace_report.main(["--incident", bundle]) == 0
+    out = capsys.readouterr().out
+    assert "INCIDENT" in out and "round_stall" in out
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # ~7 s: grpc twin of the local escalation-bundle pin
+def test_flight_escalation_grpc_bundle_same_id(tmp_path):
+    """gRPC transport: the cross-rank MSG_TYPE_FLIGHT_DUMP broadcast rides
+    a real wire; every rank converges on the SAME deterministic bundle
+    (idempotent dumps — the remote handler must not fork a second one)."""
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    with pytest.raises(RuntimeError) as exc:
+        run_fedavg_edge(
+            _edge_ds(), _escalate_cfg(tmp_path), worker_num=2,
+            comm_factory=lambda r: GRPCCommManager(
+                rank=r, size=3, base_port=56990, host="127.0.0.1"))
+    assert isinstance(exc.value.__cause__, FederationHealthError)
+    _assert_complete_escalation_bundle(tmp_path)
+
+
+# -- bit-identity + no dump on a healthy run --------------------------------
+
+def test_flight_recorder_on_bit_identical_and_silent_when_healthy(tmp_path):
+    """The recorder only reads what the round already produced: identical
+    losses and weights with the recorder on, and a healthy run dumps
+    nothing."""
+    def run(flight_dir):
+        obs.reset()
+        kw = dict(flight_dir=flight_dir, flight_window=4) if flight_dir \
+            else {}
+        return run_fedavg_edge(_edge_ds(), _edge_cfg(**kw), worker_num=2)
+
+    on = run(str(tmp_path))
+    off = run(None)
+    assert [h["loss"] for h in on.test_history] \
+        == [h["loss"] for h in off.test_history]
+    for a, b in zip(jax.tree.leaves(on.get_global_model_params()),
+                    jax.tree.leaves(off.get_global_model_params())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert _bundles(tmp_path) == []
+
+
+# -- disabled path ----------------------------------------------------------
+
+def test_flight_disabled_path_allocates_nothing():
+    """The gate mirrors the tracer's: one module-global read returning
+    None, nothing allocated on the hot path while off."""
+    import tracemalloc
+
+    assert flight.recorder_if_enabled() is None
+    gc.collect()
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(2000):
+        rec = flight.recorder_if_enabled()
+        if rec is not None:              # never taken: the recorder is off
+            rec.record_round({}, watchdog=None, tenant=None, events=None)
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    growth = sum(s.size_diff for s in after.compare_to(before, "lineno")
+                 if s.size_diff > 0)
+    assert growth < 64_000, f"disabled flight recorder leaked {growth} bytes"
+
+
+# -- fedpost: golden fixture + malformed-bundle exit ------------------------
+
+def test_fedpost_golden_fixture(capsys):
+    """fedpost over the committed fixture bundle is golden, text AND
+    markdown — the verdict derives ONLY from bundle contents."""
+    fedpost = _load_tool("fedpost")
+    assert fedpost.main([FIXTURE_BUNDLE]) == 0
+    out = capsys.readouterr().out
+    with open(os.path.join(FIXTURES, "fedpost.golden")) as f:
+        assert out == f.read()
+    assert fedpost.main([FIXTURE_BUNDLE, "--markdown"]) == 0
+    out = capsys.readouterr().out
+    with open(os.path.join(FIXTURES, "fedpost_md.golden")) as f:
+        assert out == f.read()
+
+
+def test_fedpost_malformed_bundle_exits_1(tmp_path, capsys):
+    fedpost = _load_tool("fedpost")
+    # a directory without the manifest completeness marker
+    assert fedpost.main([str(tmp_path)]) == 1
+    assert "manifest.json" in capsys.readouterr().err
+    # not a directory at all
+    assert fedpost.main([str(tmp_path / "nope")]) == 1
+    capsys.readouterr()
+    # a manifest that is not JSON
+    bad = tmp_path / "incident-dead"
+    bad.mkdir()
+    (bad / "manifest.json").write_text("{torn")
+    assert fedpost.main([str(bad)]) == 1
+    assert "manifest" in capsys.readouterr().err
+
+
+# -- fedtop INCIDENT banner -------------------------------------------------
+
+def _one_snap(path):
+    path.write_text(json.dumps(
+        {"v": 1, "ts_ms": 1, "round": 0, "source": "x"}) + "\n")
+
+
+def test_fedtop_incident_banner_single_file(tmp_path, capsys):
+    """A bundle beside the stream heads the dashboard with the banner; the
+    body below it is byte-identical to the bundle-less render (the old
+    goldens' guarantee) and the exit code is untouched."""
+    fedtop = _load_tool("fedtop")
+    pulse = tmp_path / "pulse.jsonl"
+    _one_snap(pulse)
+    assert fedtop.main([str(pulse), "--once"]) == 0
+    base = capsys.readouterr().out
+    assert "INCIDENT" not in base
+
+    bdir = tmp_path / "incident-00decafc0ffee123"
+    bdir.mkdir()
+    (bdir / "manifest.json").write_text(json.dumps(
+        {"id": "00decafc0ffee123", "rule": "round_stall", "round": 2,
+         "ts_ms": 5}))
+    assert fedtop.main([str(pulse), "--once"]) == 0
+    out = capsys.readouterr().out
+    banner, body = out.split("\n\n", 1)
+    assert banner == (f"!! INCIDENT 00decafc0ffee123: rule 'round_stall' "
+                      f"at round 2 → {bdir}")
+    assert body == base
+    # a half-dumped bundle (no manifest) is invisible — not yet an incident
+    (tmp_path / "incident-torn").mkdir()
+    assert fedtop.main([str(pulse), "--once"]) == 0
+    assert "incident-torn" not in capsys.readouterr().out
+
+
+def test_fedtop_incident_banner_directory_mode(tmp_path, capsys):
+    fedtop = _load_tool("fedtop")
+    _one_snap(tmp_path / "pulse-alpha.jsonl")
+    bdir = tmp_path / "incident-feed"
+    bdir.mkdir()
+    (bdir / "manifest.json").write_text(json.dumps(
+        {"id": "feed", "rule": "divergent_loss", "round": 1,
+         "tenant": "alpha", "ts_ms": 9}))
+    assert fedtop.main([str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("!! INCIDENT feed: rule 'divergent_loss' "
+                          "at round 1 · tenant alpha")
+    assert "tenant alpha" in out.split("\n")[0]
+
+
+# -- gateway quarantine: tenant-scoped bundle -------------------------------
+
+@pytest.mark.chaos
+def test_flight_gateway_quarantine_tenant_scoped_bundle(tmp_path):
+    """A poisoned tenant's quarantine dumps a bundle scoped to THAT tenant
+    while the healthy tenant still computes the standalone run's exact
+    weights (the recorder changes nothing it observes). The gateway never
+    calls configure_from — the caller arms the process recorder; the
+    lanes' always-escalating watchdogs feed it through plane.tenant."""
+    from fedml_tpu.distributed.gateway import run_gateway
+
+    # the proven quarantine recipe (test_gateway.py): 6-client synthetic,
+    # fast retry base with a deep budget so CI compile stalls retry through
+    ds = load_dataset("synthetic_1_1", num_clients=6, batch_size=10, seed=5)
+
+    def cfg(**kw):
+        base = dict(
+            model="lr", dataset="synthetic_1_1", client_num_in_total=6,
+            client_num_per_round=6, comm_round=2, batch_size=10, lr=0.1,
+            epochs=1, frequency_of_the_test=1, seed=5, device_data="off",
+            wire_reliable=True, wire_retry_base_s=0.02, wire_retry_max=40)
+        base.update(kw)
+        return FedConfig(**base)
+
+    solo = run_fedavg_edge(ds, cfg(), worker_num=2, timeout=120)
+    obs.reset()
+
+    flight.configure(str(tmp_path), window=4, seed=5)
+    res = run_gateway(
+        [("bad", ds, cfg(health_loss_limit=1e-9), 2),
+         ("clean", ds, cfg(), 2)],
+        transport="local", timeout=120.0)
+
+    assert res["bad"]["quarantined"]
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1, bundles
+    with open(os.path.join(bundles[0], "manifest.json")) as f:
+        man = json.load(f)
+    assert man["tenant"] == "bad" and man["kind"] == "quarantine"
+    assert man["rule"] == "divergent_loss"
+    # the bundle's round window only holds the BAD tenant's rounds
+    with open(os.path.join(bundles[0], "rounds.jsonl")) as f:
+        rounds = [json.loads(l) for l in f if l.strip()]
+    assert rounds, "quarantine bundle has an empty round window"
+    # the healthy tenant is untouched and bit-identical to standalone
+    assert not res["clean"]["quarantined"] and res["clean"]["error"] is None
+    for a, b in zip(jax.tree.leaves(solo.variables),
+                    jax.tree.leaves(res["clean"]["aggregator"].variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- flags + session plumbing ----------------------------------------------
+
+def test_flight_flags_validated():
+    with pytest.raises(ValueError, match="flight_window"):
+        FedConfig(flight_window=0)
+    with pytest.raises(ValueError, match="flight_on"):
+        FedConfig(flight_on="escalate,nonsense")
+    c = FedConfig(flight_dir="/tmp/f", flight_window=2,
+                  flight_on="escalate,manual")
+    assert c.flight_dir and c.flight_window == 2
+
+
+def test_t1_report_parses_incidents_line(tmp_path, capsys):
+    t1 = _load_tool("t1_report")
+    log = ("....\n"
+           "========= 4 passed in 1.00s =========\n"
+           "[t1] incidents: 2 bundle(s) dumped this session, "
+           "last /tmp/x/incident-ab\n")
+    rep = t1.parse_log(log)
+    assert rep["incidents"] == \
+        "2 bundle(s) dumped this session, last /tmp/x/incident-ab"
+    p = tmp_path / "t1.log"
+    p.write_text(log)
+    assert t1.main([str(p)]) == 0
+    assert "incidents: 2 bundle(s)" in capsys.readouterr().out
+    # logs predating the line parse to None and render without it
+    rep2 = t1.parse_log("....\n========= 4 passed in 1s =========\n")
+    assert rep2["incidents"] is None
+    assert "incidents" not in t1.format_report(rep2)

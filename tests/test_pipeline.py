@@ -43,7 +43,12 @@ def _reference_step(mod, tx, variables, opt_state, x, y, m):
     return optax.apply_updates(variables["params"], updates), opt_state, loss
 
 
-@pytest.mark.parametrize("n_dp,n_pp,n_micro", [(2, 4, 2), (4, 2, 4)])
+@pytest.mark.parametrize("n_dp,n_pp,n_micro", [
+    # ~15 s: the deep-pipeline shape rides the slow lane; (4, 2, 4) keeps
+    # the exact-equality pin (dp axis + microbatching) inside tier-1
+    pytest.param(2, 4, 2, marks=pytest.mark.slow),
+    (4, 2, 4),
+])
 def test_pipeline_matches_single_device(n_dp, n_pp, n_micro):
     mod = _model()
     mesh = pp_mesh(n_dp, n_pp)

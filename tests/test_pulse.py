@@ -291,6 +291,7 @@ def _edge_ds():
     return load_dataset("synthetic_1_1", num_clients=4, batch_size=10, seed=3)
 
 
+@pytest.mark.slow  # ~6 s: grpc twin of the local 4-rank bit-identity pin
 def test_pulse_grpc_edge_4_ranks_bit_identical(tmp_path):
     """The edge half of the acceptance bit-identity: a 4-rank grpc
     federation with --pulse_path streams one snapshot per round from the
@@ -839,12 +840,12 @@ OVERHEAD_BUDGET = 0.05
 @pytest.mark.slow  # ~10 s perf-budget pin (10k-cohort plane overhead)
 def test_obs_overhead_budget_10k_cohort(tmp_path):
     """A 10k-client-cohort round with the FULL plane on — sketch lanes +
-    deterministic sampled tracing + pulse stream — stays within 5% wall of
-    plane-off, and the model state is bit-identical. Measured as min round
-    wall over the post-warmup rounds (min filters scheduler contention on
-    the shared CI box; one documented re-measure for the same reason). The
-    measured delta lands in the ``[t1] obs-overhead:`` session line via
-    live.record_overhead."""
+    deterministic sampled tracing + pulse stream + the armed fedflight
+    recorder — stays within 5% wall of plane-off, and the model state is
+    bit-identical. Measured as min round wall over the post-warmup rounds
+    (min filters scheduler contention on the shared CI box; one documented
+    re-measure for the same reason). The measured delta lands in the
+    ``[t1] obs-overhead:`` session line via live.record_overhead."""
     import time
 
     from fedml_tpu.algorithms.fedavg import FedAvgAPI
@@ -860,7 +861,7 @@ def test_obs_overhead_budget_10k_cohort(tmp_path):
             d = tmp_path / tag
             pulse_path = str(d / "pulse.jsonl")
             kw = dict(pulse_path=pulse_path, trace_dir=str(d / "trace"),
-                      trace_sample_rate=0.25)
+                      trace_sample_rate=0.25, flight_dir=str(d / "flight"))
         cfg = FedConfig(model="lr", client_num_in_total=20_000,
                         client_num_per_round=10_000, comm_round=6,
                         batch_size=8, lr=0.1, frequency_of_the_test=10_000,
@@ -906,3 +907,8 @@ def test_obs_overhead_budget_10k_cohort(tmp_path):
     assert snaps[-1]["sketches"]["train_ms"]["count"] == 40_000
     # 4 draws of 10k/20k without replacement: most of the population seen
     assert 15_000 < snaps[-1]["profile"]["clients_seen"] <= 20_000
+    # the armed flight recorder rode the same budget and — healthy run —
+    # dumped nothing
+    import glob as _glob
+    assert _glob.glob(os.path.join(
+        os.path.dirname(pulse_path), "flight", "incident-*")) == []

@@ -15,9 +15,15 @@ Exit status is non-zero if ANY seed hangs or mismatches, so this slots
 straight into CI. The per-seed fault draws are deterministic
 (comm/chaos.py), so a failing seed replays exactly.
 
+``--flight_dir DIR`` arms the fedflight recorder for every run: on any
+gate failure the sweep dumps an incident bundle (full-rate span rings,
+pulse tail, replay command — see obs/flight.py) and prints its path, so
+a red sweep hands you the postmortem instead of just the seed number.
+
 Usage: python tools/chaos_sweep.py [out.json] [--seeds N] [--drop P]
                                    [--dup P] [--reorder P] [--delay_ms D]
                                    [--rounds R] [--timeout S]
+                                   [--flight_dir DIR]
 """
 
 from __future__ import annotations
@@ -35,6 +41,21 @@ def _arg(argv, flag, default, cast=float):
     return default
 
 
+def _flight_dump(rule: str, round_idx: int, reason: str) -> None:
+    """Dump an incident bundle for a failed gate and print its path.
+    No-op (trigger returns None) when no recorder is armed — the sweep
+    ran without --flight_dir."""
+    try:
+        from fedml_tpu.obs import flight
+
+        bundle = flight.trigger(rule, round_idx, kind="manual",
+                                reason=reason)
+        if bundle:
+            print(f"flight bundle: {bundle}", file=sys.stderr)
+    except Exception:
+        pass
+
+
 def main(argv):
     out_path = argv[0] if argv and not argv[0].startswith("-") else None
     seeds = _arg(argv, "--seeds", 5, int)
@@ -44,6 +65,7 @@ def main(argv):
     delay_ms = _arg(argv, "--delay_ms", 0.0)
     rounds = _arg(argv, "--rounds", 3, int)
     timeout = _arg(argv, "--timeout", 120.0)
+    flight_dir = _arg(argv, "--flight_dir", None, str)
 
     from fedml_tpu.core.config import FedConfig
     from fedml_tpu.data import load_dataset
@@ -56,7 +78,7 @@ def main(argv):
             model="lr", dataset="synthetic_1_1", client_num_in_total=6,
             client_num_per_round=6, comm_round=rounds, batch_size=10,
             lr=0.1, epochs=1, frequency_of_the_test=1, seed=5,
-            device_data="off", **kw)
+            device_data="off", flight_dir=flight_dir, **kw)
 
     def history(agg):
         return [(h["round"], float(h["acc"]), float(h["loss"]))
@@ -80,6 +102,7 @@ def main(argv):
             failed += 1
             results.append(rec)
             print(f"seed {chaos_seed}: FAIL ({rec['error']})", file=sys.stderr)
+            _flight_dump("sweep_gate", chaos_seed, rec["error"])
             continue
         rec["wire_stats"] = {k: int(v) for k, v in agg.wire_stats.items()}
         rec["uploads_accepted"] = agg.uploads_accepted
@@ -93,6 +116,7 @@ def main(argv):
         if not rec["ok"]:
             failed += 1
             print(f"seed {chaos_seed}: FAIL ({rec['error']})", file=sys.stderr)
+            _flight_dump("sweep_gate", chaos_seed, rec["error"])
         else:
             print(f"seed {chaos_seed}: ok "
                   f"(retransmits={rec['wire_stats'].get('wire/retransmits', 0)}, "

@@ -18,8 +18,13 @@ a deadlocked teardown surfaces as a reported hang (non-zero exit), never
 a silent CI stall — this slots next to tools/chaos_sweep.py and
 tools/xdev_ab.py.
 
+``--flight_dir DIR`` arms the fedflight recorder for every run: on any
+gate failure (including a hang — the wedged run's rings are still live)
+the sweep dumps an incident bundle and prints its path.
+
 Usage: python tools/fedbuff_ab.py [out.json] [--seeds N] [--versions V]
                                   [--workers W] [--delay MS] [--timeout S]
+                                  [--flight_dir DIR]
 """
 
 from __future__ import annotations
@@ -57,6 +62,22 @@ def _run_with_watchdog(fn, timeout: float):
     return out.get("result"), out.get("error")
 
 
+def _flight_dump(rule: str, round_idx: int, reason: str) -> None:
+    """Dump an incident bundle for a failed gate and print its path.
+    No-op (trigger returns None) when no recorder is armed — the sweep
+    ran without --flight_dir. On a hang the wedged run's recorder is
+    still the armed one, so the dump captures its live rings."""
+    try:
+        from fedml_tpu.obs import flight
+
+        bundle = flight.trigger(rule, round_idx, kind="manual",
+                                reason=reason)
+        if bundle:
+            print(f"flight bundle: {bundle}", file=sys.stderr)
+    except Exception:
+        pass
+
+
 def main(argv):
     out_path = argv[0] if argv and not argv[0].startswith("-") else None
     seeds = _arg(argv, "--seeds", 3, int)
@@ -64,6 +85,7 @@ def main(argv):
     workers = _arg(argv, "--workers", 3, int)
     delay_ms = _arg(argv, "--delay", 60.0)
     timeout = _arg(argv, "--timeout", 120.0)
+    flight_dir = _arg(argv, "--flight_dir", None, str)
 
     import time
 
@@ -103,6 +125,7 @@ def main(argv):
                 client_num_in_total=cohort, client_num_per_round=cohort,
                 comm_round=versions, batch_size=8, epochs=1, lr=0.1,
                 seed=seed, frequency_of_the_test=1, device_data="off",
+                flight_dir=flight_dir,
                 # fast gave-up schedule: dead-peer detection in ~1.4 s
                 wire_retry_base_s=0.02, wire_retry_max=6)
             base.update(kw)
@@ -167,6 +190,7 @@ def main(argv):
         if not rec["ok"]:
             failed += 1
             print(f"seed {seed}: FAIL ({rec['error']})", file=sys.stderr)
+            _flight_dump("sweep_gate", seed, rec["error"])
         else:
             print(f"seed {seed}: ok (async/sync "
                   f"{rec['ab']['async_vs_sync']}x)")

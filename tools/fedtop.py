@@ -26,6 +26,14 @@ goldenable one: output derives ONLY from file contents, never the wall
 clock). Live mode redraws on every appended snapshot and flags a stream
 that stopped moving (no new snapshot for ``--stall`` seconds).
 
+INCIDENT BANNER: when fedflight bundles (``incident-<id>/`` directories
+holding a ``manifest.json``) sit beside the stream — in the pulse file's
+directory, or in the directory itself in directory mode — the dashboard
+is headed by a banner naming each incident's rule, round and bundle path
+(newest last, capped at 3), pointing at ``tools/fedpost.py`` for the full
+verdict. Streams without bundles render byte-identically to before, so
+every existing golden holds; the banner never changes the exit code.
+
 Exit codes (``--once``): 0 healthy/warn; 1 the stream's health state is
 critical (directory mode: ANY tenant critical); 2 no file / no parseable
 snapshots (directory mode: no streams with snapshots). Live mode exits 0
@@ -151,6 +159,49 @@ def stream_signature(path: str):
     except OSError:
         return None
     return (st.st_dev, st.st_ino)
+
+
+def find_incidents(root: str) -> list[dict]:
+    """fedflight bundles beside the stream: every ``incident-<id>/`` under
+    ``root`` whose ``manifest.json`` parses (the manifest is written last,
+    so an entry here is a COMPLETE bundle), oldest first. Unreadable or
+    half-dumped directories are skipped — same tolerance as the JSONL
+    layer."""
+    out = []
+    pat = os.path.join(root, "incident-*", "manifest.json")
+    for man_path in sorted(glob.glob(pat)):
+        try:
+            with open(man_path, encoding="utf-8") as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(man, dict) and man.get("id"):
+            out.append({"id": man["id"], "rule": man.get("rule"),
+                        "round": man.get("round"),
+                        "tenant": man.get("tenant"),
+                        "ts_ms": man.get("ts_ms") or 0,
+                        "bundle": os.path.dirname(man_path)})
+    out.sort(key=lambda m: (m["ts_ms"], m["id"]))
+    return out
+
+
+def incident_banner(root: str) -> str:
+    """The banner block ('' when no bundles exist — the byte-identity path
+    every pre-flight golden rides)."""
+    incs = find_incidents(root)
+    if not incs:
+        return ""
+    lines = []
+    if len(incs) > 3:
+        lines.append(f"!! {len(incs)} incident bundle(s), newest 3 shown "
+                     "(tools/fedpost.py renders the full verdict)")
+    for m in incs[-3:]:
+        lines.append(
+            f"!! INCIDENT {m['id']}: rule {m['rule']!r} at round "
+            f"{m['round']}"
+            + (f" · tenant {m['tenant']}" if m.get("tenant") else "")
+            + f" → {m['bundle']}")
+    return "\n".join(lines)
 
 
 def _rates(snaps: list[dict]) -> dict:
@@ -307,6 +358,13 @@ def render_dir(sections: list[tuple[str, str, list[dict], float]],
     return "\n".join(lines)
 
 
+def _with_banner(body: str, root: str) -> str:
+    """Prepend the incident banner when bundles exist beside the stream;
+    the no-bundle path returns ``body`` unchanged (golden byte-identity)."""
+    banner = incident_banner(root)
+    return banner + "\n\n" + body if banner else body
+
+
 def _main_dir(args) -> int:
     paths = discover_streams(args.pulse, args.tenant)
     sections = []
@@ -318,7 +376,7 @@ def _main_dir(args) -> int:
             print(f"fedtop: no pulse-*.jsonl snapshots in {args.pulse}",
                   file=sys.stderr)
             return 2
-        print(render_dir(sections, args.pulse))
+        print(_with_banner(render_dir(sections, args.pulse), args.pulse))
         states = [(s[2][-1].get("health") or {}).get("state")
                   for s in sections if s[2]]
         return 1 if "critical" in states else 0
@@ -341,9 +399,11 @@ def _main_dir(args) -> int:
                     (tenant_of(p), p, snaps_by[p],
                      stalled if stalled > args.stall else 0.0))
             if any(s[2] for s in body_sections):
-                sys.stdout.write("\x1b[2J\x1b[H"
-                                 + render_dir(body_sections, args.pulse)
-                                 + "\n")
+                sys.stdout.write(
+                    "\x1b[2J\x1b[H"
+                    + _with_banner(render_dir(body_sections, args.pulse),
+                                   args.pulse)
+                    + "\n")
             else:
                 sys.stdout.write(
                     f"fedtop: waiting for pulse-*.jsonl in {args.pulse} "
@@ -392,7 +452,8 @@ def main(argv=None) -> int:
             print(f"fedtop: no pulse snapshots in {args.pulse}",
                   file=sys.stderr)
             return 2
-        print(render(snaps, args.pulse))
+        print(_with_banner(render(snaps, args.pulse),
+                           os.path.dirname(args.pulse) or "."))
         state = (snaps[-1].get("health") or {}).get("state")
         return 1 if state == "critical" else 0
 
@@ -406,6 +467,8 @@ def main(argv=None) -> int:
                 body = render(snaps, args.pulse,
                               stalled_s=stalled if stalled > args.stall
                               else 0.0)
+                body = _with_banner(body,
+                                    os.path.dirname(args.pulse) or ".")
                 sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
             else:
                 sys.stdout.write(f"fedtop: waiting for {args.pulse} ...\n")

@@ -27,9 +27,15 @@ a deadlocked teardown surfaces as a reported hang (non-zero exit), never
 a silent CI stall — this slots next to tools/fedbuff_ab.py and
 tools/chaos_sweep.py.
 
+``--flight_dir DIR`` arms the fedflight recorder for the federation
+phase: on any gate failure the sweep dumps an incident bundle and prints
+its path (the EXPECTED quarantine of the poisoned tenant also leaves its
+tenant-scoped bundle there — that one documents the test's own fault
+injection, not a sweep failure).
+
 Usage: python tools/gateway_sweep.py [out.json] [--seeds N] [--tenants T]
                                      [--senders S] [--msgs M] [--cap C]
-                                     [--timeout S]
+                                     [--timeout S] [--flight_dir DIR]
 """
 
 from __future__ import annotations
@@ -68,9 +74,25 @@ def _run_with_watchdog(fn, timeout: float):
     return out.get("result"), out.get("error")
 
 
+def _flight_dump(rule: str, round_idx: int, reason: str) -> None:
+    """Dump an incident bundle for a failed gate and print its path.
+    No-op (trigger returns None) when no recorder is armed — the sweep
+    ran without --flight_dir."""
+    try:
+        from fedml_tpu.obs import flight
+
+        bundle = flight.trigger(rule, round_idx, kind="manual",
+                                reason=reason)
+        if bundle:
+            print(f"flight bundle: {bundle}", file=sys.stderr)
+    except Exception:
+        pass
+
+
 # -- phase 1: federation-level isolation -------------------------------------
 
-def _isolation_phase(seed: int, timeout: float, pulse_root: str):
+def _isolation_phase(seed: int, timeout: float, pulse_root: str,
+                     flight_dir=None):
     import jax
     import numpy as np
 
@@ -91,7 +113,7 @@ def _isolation_phase(seed: int, timeout: float, pulse_root: str):
             model="lr", dataset="gwsweep", client_num_in_total=cohort,
             client_num_per_round=cohort, comm_round=rounds, batch_size=8,
             epochs=1, lr=0.1, seed=seed, frequency_of_the_test=1,
-            device_data="off", wire_reliable=True,
+            device_data="off", wire_reliable=True, flight_dir=flight_dir,
             # fast base so chaos retries resolve in milliseconds, but a DEEP
             # budget (~37s worst case): 5 tenants jit-compiling concurrently
             # on a 1-core box can stall any one worker's ack for seconds,
@@ -314,6 +336,7 @@ def main(argv):
     msgs = _arg(argv, "--msgs", 4, int)
     cap = _arg(argv, "--cap", 8, int)
     timeout = _arg(argv, "--timeout", 180.0)
+    flight_dir = _arg(argv, "--flight_dir", None, str)
 
     import tempfile
 
@@ -338,7 +361,8 @@ def main(argv):
     for seed in range(seeds):
         rec = {"seed": seed, "ok": False}
         iso, err = _run_with_watchdog(
-            lambda: _isolation_phase(seed, timeout, pulse_root), timeout)
+            lambda: _isolation_phase(seed, timeout, pulse_root, flight_dir),
+            timeout)
         if err is None and iso["errors"]:
             err = "; ".join(iso["errors"])
         if err is None:
@@ -355,6 +379,7 @@ def main(argv):
             rec["error"] = err
             failed += 1
             print(f"seed {seed}: FAIL ({err})", file=sys.stderr)
+            _flight_dump("sweep_gate", seed, err or "gate failure")
         else:
             print(f"seed {seed}: ok ({flood['simulated_workers']} simulated "
                   f"workers, {flood['msgs_per_sec']} msg/s, "

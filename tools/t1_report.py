@@ -15,8 +15,10 @@ Report: DOTS (passed-in-window, the gate's own regex), outcome summary
 line, failure/error names, the slowest-10 test files, the compile-cache
 line, the plan-cache line (fedplan candidate micro-lowering hits/misses),
 the obs-overhead line (the pinned full-plane-on vs off wall
-delta from the fedsketch budget test), and the fedlint line (rule count
-plus unsuppressed/suppressed finding counts over the real tree).
+delta from the fedsketch budget test), the fedlint line (rule count
+plus unsuppressed/suppressed finding counts over the real tree), and the
+incidents line (fedflight bundles dumped during the session — a green
+run's count is stable: only the flight tests' own expected dumps).
 ``--json`` emits the same as one JSON object.
 
 Exit codes: 0 parsed; 2 when the file has no pytest progress output at all
@@ -48,6 +50,7 @@ CACHE_RE = re.compile(r"^\[t1\] compile-cache: (.*)$")
 PLAN_CACHE_RE = re.compile(r"^\[t1\] plan-cache: (.*)$")
 OBS_OVERHEAD_RE = re.compile(r"^\[t1\] obs-overhead: (.*)$")
 FEDLINT_RE = re.compile(r"^\[t1\] fedlint: (.*)$")
+INCIDENTS_RE = re.compile(r"^\[t1\] incidents: (.*)$")
 
 
 def parse_log(text: str) -> dict:
@@ -60,6 +63,7 @@ def parse_log(text: str) -> dict:
     plan_cache = None
     obs_overhead = None
     fedlint = None
+    incidents = None
     for line in text.splitlines():
         line = line.rstrip()
         if DOTS_RE.match(line):
@@ -95,6 +99,10 @@ def parse_log(text: str) -> dict:
         m = FEDLINT_RE.match(line)
         if m:
             fedlint = m.group(1)
+            continue
+        m = INCIDENTS_RE.match(line)
+        if m:
+            incidents = m.group(1)
     return {
         "dots": dots,
         "dots_baseline": BASELINE_DOTS,
@@ -108,6 +116,7 @@ def parse_log(text: str) -> dict:
         "plan_cache": plan_cache,
         "obs_overhead": obs_overhead,
         "fedlint": fedlint,
+        "incidents": incidents,
     }
 
 
@@ -131,6 +140,8 @@ def format_report(rep: dict) -> str:
         lines.append(f"obs-overhead: {rep['obs_overhead']}")
     if rep.get("fedlint"):
         lines.append(f"fedlint: {rep['fedlint']}")
+    if rep.get("incidents"):
+        lines.append(f"incidents: {rep['incidents']}")
     if rep["slowest_files"]:
         lines.append("slowest files (wall seconds in this session):")
         for path, secs in rep["slowest_files"]:

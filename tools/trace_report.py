@@ -48,6 +48,14 @@ Report sections:
   train-ms / upload-latency / payload / staleness read as one
   distribution. Streams without sketches add nothing.
 
+``--incident <bundle>`` swaps the input for a fedflight ``incident-<id>/``
+bundle: the per-rank flight-ring dumps (full-rate capture of the last
+``--flight_window`` rounds, regardless of ``--trace_sample_rate``) feed the
+same merge + critical-path machinery, the bundle's ``pulse-tail.jsonl``
+feeds the fedpulse/fedsketch joins, and the report is headed by the
+incident's id/rule/round from the manifest. Windowed rings legitimately
+truncate the oldest round, so expect (and read past) boundary anomalies.
+
 Exit codes: 0 clean; 1 structural anomalies — unclosed spans, rounds
 missing on some rank, recv spans with no matching send (span imbalance) —
 or wire gave_up; 2 nothing to analyze (no files, or files holding only
@@ -83,6 +91,20 @@ def load_trace_dir(trace_dir: str) -> list[dict]:
     """All events from every per-(process, rank) file, sorted by timestamp."""
     events: list[dict] = []
     for path in sorted(glob.glob(os.path.join(trace_dir, "trace-*.jsonl"))):
+        events.extend(read_jsonl(path))
+    events.sort(key=lambda e: e.get("ts", 0))
+    return events
+
+
+def load_incident_bundle(bundle: str) -> list[dict]:
+    """All events from a fedflight ``incident-<id>/`` bundle's per-rank
+    flight-ring dumps (``ring-rank<r>.jsonl`` / ``ring-p<p>-rank<r>.jsonl``),
+    sorted by timestamp. The rings hold the last ``--flight_window`` rounds at
+    FULL rate regardless of ``--trace_sample_rate``, so the analysis covers
+    exactly the window leading into the incident — expect the oldest round to
+    be cut mid-flight and the incident round's spans to stop at the trigger."""
+    events: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(bundle, "ring-*.jsonl"))):
         events.extend(read_jsonl(path))
     events.sort(key=lambda e: e.get("ts", 0))
     return events
@@ -540,6 +562,13 @@ def format_report(rep: dict) -> str:
     lines.append(f"fedtrace report: {rep['events']} events, "
                  f"{len(rep['ranks'])} rank(s) {rep['ranks']}, "
                  f"{rep['rounds']} round(s)")
+    inc = rep.get("incident")
+    if inc:
+        row = (f"INCIDENT {inc.get('id')}: rule {inc.get('rule')!r} "
+               f"at round {inc.get('round')} ({inc.get('kind')})")
+        if inc.get("tenant"):
+            row += f" tenant {inc['tenant']!r}"
+        lines.append(row)
     lines.append("")
     lines.append("round timeline:")
     for e in rep["timeline"]:
@@ -697,7 +726,12 @@ def format_report(rep: dict) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("trace_dir", help="directory of trace-rank*.jsonl files")
+    ap.add_argument("trace_dir", nargs="?",
+                    help="directory of trace-rank*.jsonl files")
+    ap.add_argument("--incident", metavar="BUNDLE",
+                    help="analyze a fedflight incident-<id>/ bundle instead "
+                         "of a trace dir: the per-rank flight-ring dumps go "
+                         "through the same merge + critical-path machinery")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of text")
     ap.add_argument("--perfetto", metavar="OUT",
@@ -705,24 +739,50 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-ranks", type=int, default=0,
                     help="fail unless at least this many ranks are present")
     args = ap.parse_args(argv)
+    if bool(args.trace_dir) == bool(args.incident):
+        ap.error("exactly one of trace_dir or --incident is required")
 
-    events = load_trace_dir(args.trace_dir)
+    src = args.incident or args.trace_dir
+    events = (load_incident_bundle(src) if args.incident
+              else load_trace_dir(src))
     if not events:
-        print(f"no trace-*.jsonl events under {args.trace_dir}",
-              file=sys.stderr)
+        kind = "ring-*.jsonl" if args.incident else "trace-*.jsonl"
+        print(f"no {kind} events under {src}", file=sys.stderr)
         return 2
     if not has_span_events(events):
         # a run can flush registry snapshots without ever opening a span
         # (e.g. counters-only instrumentation); there is no span graph to
         # analyze, and pretending the trace is "clean" would mask the gap
-        print(f"no span events under {args.trace_dir} (only "
+        print(f"no span events under {src} (only "
               "registry/counter snapshots); nothing to analyze",
               file=sys.stderr)
         return 2
     rep = analyze(events, expect_ranks=args.expect_ranks)
+    if args.incident:
+        # the bundle's manifest identifies WHAT this window led into; the
+        # pulse tail inside the bundle feeds the same joins a trace dir's
+        # pulse.jsonl would (the tail file uses the identical JSONL shape)
+        man_path = os.path.join(src, "manifest.json")
+        if os.path.exists(man_path):
+            try:
+                with open(man_path, encoding="utf-8") as f:
+                    man = json.load(f)
+                rep["incident"] = {k: man.get(k) for k in
+                                   ("id", "rule", "round", "kind", "tenant")}
+            except (OSError, ValueError):
+                rep["anomalies"].append("unreadable manifest.json in bundle")
+        else:
+            rep["anomalies"].append(
+                "incomplete bundle: no manifest.json (dump interrupted?)")
     # one parse pass over every pulse*.jsonl: the primary stream feeds the
     # client-profiles join, all streams feed the cross-host sketch fold
-    streams = load_pulse_streams(args.trace_dir)
+    streams = load_pulse_streams(src)
+    if args.incident and not streams:
+        tail = os.path.join(src, "pulse-tail.jsonl")
+        if os.path.exists(tail):
+            snaps, _off = read_snapshots(tail)
+            if snaps:
+                streams = {"pulse.jsonl": snaps}
     pulse = streams.get("pulse.jsonl")
     if pulse:
         # additive join: exit codes and the span-graph sections are
