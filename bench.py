@@ -822,6 +822,16 @@ def main():
         pulse_plane = fedpulse.configure(
             os.environ.get("BENCH_PULSE_PATH"), profile_store=True)
 
+    # fedlens: arm the learning-signal lane for the flagship pass — output-
+    # only reductions riding the round program (bit-identical weights,
+    # obs/lens.py), so the tail carries the per-client update-norm/drift
+    # distribution tails at the flagship operating point. Needs the pulse
+    # plane (its profiler owns the sketch lanes). BENCH_NO_LENS=1 opts out.
+    from fedml_tpu.obs import lens as fedlens
+
+    if pulse_plane is not None and not os.environ.get("BENCH_NO_LENS"):
+        fedlens.configure(True)
+
     # BENCH_SCALE=tiny: CI/CPU smoke of the same code path (not a benchmark).
     tiny = os.environ.get("BENCH_SCALE") == "tiny"
     model = os.environ.get("BENCH_MODEL", "resnet56")
@@ -922,6 +932,17 @@ def main():
         flagship_profiler = pulse_plane.aggregates()
         if pulse_plane.profiler is not None:
             pulse_plane.profiler.reset()
+    # fedlens summary for the tail: the measured pass's update-norm/drift
+    # sketch summaries (bench_report's `p99 update norm` / `drift p99`
+    # columns read these) plus the session fold accounting. None when the
+    # lens (or the pulse plane it feeds) is off — missing keys render "-".
+    lens_summary = None
+    if pulse_plane is not None and fedlens.lens_enabled():
+        sk = (flagship_profiler or {}).get("sketches") or {}
+        st = fedlens.session_stats()
+        lens_summary = {"update_norm": sk.get("update_norm"),
+                        "drift": sk.get("drift"),
+                        "folds": st["folds"], "suspects": st["suspects"]}
 
     # fedpack flagship A/B (ISSUE 9): both packed-conv lowerings measured
     # through the same harness, embedded as the `packed_conv` block. Runs
@@ -1083,6 +1104,8 @@ def main():
         # carries the fedsketch `sketches` summaries (count + p50/p90/p99
         # per lane) that bench_report's trajectory columns parse
         "profiler": flagship_profiler,
+        # fedlens learning-signal tails at the flagship operating point
+        "lens": lens_summary,
         "roofline": roofline,
         "registry": registry_snapshot,
         "device": str(jax.devices()[0]),

@@ -333,6 +333,15 @@ class FedAvgAPI:
         new_vars = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_vars, variables)
         new_state = jax.tree.map(lambda n, o: jnp.where(keep, n, o), new_state, server_state)
         train_loss = jnp.sum(res.train_loss * counts) / jnp.maximum(total, 1e-12)
+        if self._lens_armed:
+            # fedlens lane (obs/lens.py): output-only reductions over the
+            # stacked cohort result the program already holds — nothing
+            # here feeds new_vars/new_state, so an armed program computes
+            # bit-identical weights (pinned by tests/test_lens.py)
+            from fedml_tpu.obs.lens import stacked_lens
+
+            return (new_vars, new_state, train_loss,
+                    stacked_lens(variables, res, counts))
         return new_vars, new_state, train_loss
 
     def build_round_step(self):
@@ -586,18 +595,21 @@ class FedAvgAPI:
         pconv = resolve_packed_conv(c.packed_conv, self.bundle,
                                     int(shape_key[0]),
                                     optimizer=c.client_optimizer)
+        lens_on = self._lens_armed
         packed = make_packed_cohort_train(
             self.bundle, self.task, n_pad, shape_key,
             packed_conv=pconv,
             client_transform=hooks.get("client_transform"),
             reduce_extras=hooks.get("reduce_extras"),
+            lens=lens_on,
             **self._local_train_kwargs())
 
         @jax.jit
         def round_step(variables, server_state, tx, ty, tm, rows, weights,
                        rng, plan_arrays):
-            acc, acc_w, acc_loss, _tau, extras = packed(
+            out = packed(
                 variables, tx, ty, tm, rows, weights, rng, plan_arrays)
+            acc, acc_w, acc_loss, _tau, extras = out[:5]
             denom = jnp.maximum(acc_w, 1e-12)
             agg = jax.tree.map(
                 lambda a, v: (a / denom).astype(v.dtype), acc, variables)
@@ -607,6 +619,12 @@ class FedAvgAPI:
             new_vars, new_state = apply_server_and_rollback(
                 variables, agg, extras if has_extras else None, acc_w,
                 server_state, rng, server_update)
+            if lens_on:
+                from fedml_tpu.obs.lens import packed_lens
+
+                upd, lf, ll, mw = out[5]
+                return (new_vars, new_state, acc_loss / denom,
+                        packed_lens(upd, lf, ll, mw))
             return new_vars, new_state, acc_loss / denom
 
         # fedcost packing hint (obs/cost.attribute_program): the joint
@@ -623,7 +641,7 @@ class FedAvgAPI:
             round_step.cost_hints["plan"] = pconv
         return round_step
 
-    def _run_packed_round(self, sampled, live, rk):
+    def _run_packed_round(self, sampled, live, rk, round_idx=0):
         """Execute the round under the packed schedule; returns (variables,
         server_state, loss) or None when packing doesn't apply this round.
         ``live`` already folds the Silo client-active mask (_round_plan);
@@ -652,9 +670,19 @@ class FedAvgAPI:
             plan_arrays = mask_plan_arrays(
                 plan, np.asarray(active, np.float32)[sampled][plan.member_pos])
         tx, ty, tm, _tc = self._dev_train
-        return step(self.variables, self.server_state, tx, ty, tm,
-                    jnp.asarray(sampled, jnp.int32), jnp.asarray(weights),
-                    rk, tuple(jnp.asarray(a) for a in plan_arrays))
+        out = step(self.variables, self.server_state, tx, ty, tm,
+                   jnp.asarray(sampled, jnp.int32), jnp.asarray(weights),
+                   rk, tuple(jnp.asarray(a) for a in plan_arrays))
+        if len(out) == 4:
+            # packed_lens flattens [n_lanes, k_max] in member_pos order;
+            # padding slots (member_valid 0) and dead/exited members
+            # (weight 0) are dropped host-side via the valid mask
+            mp = np.asarray(plan.member_pos, np.int64).reshape(-1)
+            mv = np.asarray(plan_arrays[7], np.float64).reshape(-1)
+            valid = (mv > 0) & (np.asarray(weights, np.float64)[mp] > 0)
+            out = self._lens_absorb(round_idx, out,
+                                    np.asarray(sampled, np.int64)[mp], valid)
+        return out
 
     def _sample_failures(self, round_idx: int, cohort: int,
                          record: bool = True) -> Optional[np.ndarray]:
@@ -1334,6 +1362,61 @@ class FedAvgAPI:
             ids = ids[np.asarray(live) > 0]
         return ids
 
+    # -- fedlens (obs/lens.py) ----------------------------------------------
+
+    #: class-level defaults so subclasses need no __init__ surgery; the
+    #: armed state is snapshotted at the FIRST armed-check (i.e. the first
+    #: round program trace), mirroring the tracer's arm-before-build rule
+    _lens_state: "Optional[bool]" = None
+    _lens_stash = None
+    _lens_prev = None
+
+    @property
+    def _lens_armed(self) -> bool:
+        on = self._lens_state
+        if on is None:
+            from fedml_tpu.obs.lens import lens_enabled
+
+            # one-time snapshot BY DESIGN: the armed bit is frozen at the
+            # first round-program trace so lens on/off can never re-trace
+            # mid-run (the trace-time-only behavior the rule warns about
+            # is exactly the contract)  # fedlint: disable=traced-purity
+            on = self._lens_state = bool(lens_enabled())
+        return on
+
+    def _lens_absorb(self, round_idx: int, out, ids, valid=None):
+        """Strip + stash the lens element when an armed round program
+        returned one (device arrays stay un-synced); 3-tuples pass
+        through. ``ids`` are the logical client ids in the lens arrays'
+        stacking order; ``valid`` masks padding/failed entries."""
+        if len(out) == 4:
+            self._lens_stash = (
+                int(round_idx), np.asarray(ids, np.int64),
+                None if valid is None else np.asarray(valid, bool), out[3])
+            out = out[:3]
+        return out
+
+    def _pulse_lens(self, round_idx: int):
+        """The round's lens stats as host arrays for the pulse feed —
+        ``(round, ids, {"update_norm", "align"[, "loss_delta"]})`` or
+        None. Under ``--async_rounds`` conversion runs one round LATE (the
+        previous round's arrays are already materialized), so the feed
+        never forces a host sync on the round just dispatched; ids ride
+        with their stats, so the one-round lag cannot misattribute."""
+        cur, self._lens_stash = self._lens_stash, None
+        if self.config.async_rounds:
+            cur, self._lens_prev = self._lens_prev, cur
+        if cur is None:
+            return None
+        r, ids, valid, dev = cur
+        stats = {k: np.asarray(v, np.float64) for k, v in dev.items()}
+        if valid is not None:
+            ids = ids[valid]
+            stats = {k: v[valid] for k, v in stats.items()}
+        if ids.size == 0:
+            return None
+        return r, ids, stats
+
     def _pulse_cohort_shares(self, ids) -> "Optional[np.ndarray]":
         """Per-client share of the round wall for the fedpulse profiler
         feed: proportional to each client's record count — within a fused
@@ -1359,7 +1442,7 @@ class FedAvgAPI:
             live_np = (np.ones((len(sampled),), np.float32) if live is None
                        else np.asarray(live, np.float32))
             if self.config.pack_lanes > 0:
-                out = self._run_packed_round(sampled, live, rk)
+                out = self._run_packed_round(sampled, live, rk, round_idx)
                 if out is not None:
                     self.variables, self.server_state, train_loss = out
                     return (train_loss if self.config.async_rounds
@@ -1371,12 +1454,16 @@ class FedAvgAPI:
                     self._group_steps, groups,
                     lambda: self.build_round_step_gather_groups(groups),
                     "group_step")
-                self.variables, self.server_state, train_loss = step(
+                out = step(
                     self.variables, self.server_state, *self._dev_train,
                     jnp.asarray(sampled[perm], jnp.int32),
                     jnp.asarray(live_np[perm]),
                     jnp.asarray(perm, jnp.int32), rk
                 )
+                self.variables, self.server_state, train_loss = \
+                    self._lens_absorb(round_idx, out,
+                                      np.asarray(sampled, np.int64)[perm],
+                                      live_np[perm] > 0)
                 return train_loss if self.config.async_rounds else float(train_loss)
             if bucket is None:
                 step = self._round_step_gather
@@ -1385,10 +1472,12 @@ class FedAvgAPI:
                     self._gather_steps, bucket,
                     lambda: self.build_round_step_gather(bucket),
                     "gather_step")
-            self.variables, self.server_state, train_loss = step(
+            out = step(
                 self.variables, self.server_state, *self._dev_train,
                 jnp.asarray(sampled, jnp.int32), jnp.asarray(live_np), rk
             )
+            self.variables, self.server_state, train_loss = \
+                self._lens_absorb(round_idx, out, sampled, live_np > 0)
         else:
             if self._stream_mode() != "off":
                 # fedsched streaming path: sub-cohort chunks fold into the
@@ -1420,10 +1509,23 @@ class FedAvgAPI:
                 stages, wait_ms = {"materialize_ms": mat_ms, "h2d_ms": 0.0}, mat_ms
                 step = self._round_step
             t0 = time.perf_counter()
-            self.variables, self.server_state, train_loss = step(
+            out = step(
                 self.variables, self.server_state, cx, cy, cm,
                 jnp.asarray(counts, jnp.float32), rk
             )
+            if len(out) == 4:
+                # host-path cohort order is the stashed plan's sampled
+                # order; the prefetcher stashes its plans too, so the id
+                # mapping survives pipelining (absent plan = lens skipped)
+                plan_s = self._plan_stash.get(int(round_idx))
+                if plan_s is not None:
+                    s_ids, s_live = plan_s
+                    out = self._lens_absorb(
+                        round_idx, out, s_ids,
+                        None if s_live is None else np.asarray(s_live) > 0)
+                else:
+                    out = out[:3]
+            self.variables, self.server_state, train_loss = out
             if not self.config.async_rounds:
                 train_loss = float(train_loss)
             row = dict(stages, wait_ms=wait_ms, round=round_idx,
@@ -2044,10 +2146,16 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         if live is not None:
             counts = counts * jnp.asarray(live, jnp.float32)
         rk = round_key(self.root_key, round_idx)
-        self.variables, self.server_state, train_loss = \
-            self._traced_device_step(
-                "sharded", round_idx, self._round_step,
-                self.variables, self.server_state, cx, cy, cm, counts, rk)
+        out = self._traced_device_step(
+            "sharded", round_idx, self._round_step,
+            self.variables, self.server_state, cx, cy, cm, counts, rk)
+        # fedlens (plain mesh): full participation in dataset order, so the
+        # logical ids are simply arange; failure/exit masks drop zero-weight
+        # clients from the stash host-side
+        self.variables, self.server_state, train_loss = self._lens_absorb(
+            round_idx, out,
+            np.arange(self.dataset.num_clients, dtype=np.int64),
+            None if live is None else np.asarray(live) > 0)
         return train_loss if self.config.async_rounds else float(train_loss)
 
     def round_counts(self, round_idx: int) -> tuple:
@@ -2093,6 +2201,7 @@ class CrossSiloFedAvgAPI(FedAvgAPI):
         from fedml_tpu.parallel.mesh import replicated
 
         round_fn = make_crosssilo_round(self._local_train, self.mesh,
+                                        lens=self._lens_armed,
                                         **self._crosssilo_hooks_checked())
 
         def round_step(variables, server_state, cx, cy, cm, counts, rng):

@@ -55,6 +55,12 @@ Fold order (``--buffer_mode``, mirroring ``--stream_aggregate``):
 This module is the transport-free server-side logic; the async edge
 protocol lives in distributed/fedbuff_edge.py. DESIGN.md §18 has the
 weighting math, the determinism argument, and the degradation table.
+
+fedlens note: because every upload here already IS a raw update delta,
+the edge manager's lens feed (``--lens on``) gets per-client update norms
+for free at fold time, and scores alignment against the LAST emitted
+server update (an async fold has no same-version cohort mean to compare
+against) — see ``FedBuffEdgeServerManager._fold`` and DESIGN.md §22.
 """
 
 from __future__ import annotations

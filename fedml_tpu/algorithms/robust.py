@@ -11,6 +11,14 @@ accuracy.
 Attack model here: pixel-trigger backdoor — the attacker stamps a trigger
 patch on its samples and relabels them to ``target_class``; backdoor success
 = fraction of triggered test inputs classified as the target.
+
+Detection counterpart: the fedlens telemetry (``--lens on``, obs/lens.py)
+scores every client's RAW update — pre-``client_transform``, so the clip
+defense here cannot hide the attacker from its own server's telemetry —
+and the watchdog's ``aligned_suspects`` rule names the anti-aligned
+high-norm client ids. The e2e pin (tests/test_lens.py) runs exactly this
+attacker through an armed federation and asserts ``attacker_idx`` tops the
+suspect list.
 """
 
 from __future__ import annotations
@@ -45,16 +53,21 @@ class FedAvgRobustAPI(FedAvgAPI):
 
     def __init__(self, dataset, config, bundle=None,
                  attacker_idx: int = 0, target_class: int = 1,
-                 poison_frac: Optional[float] = None):
+                 poison_frac: Optional[float] = None,
+                 trigger_value: float = 2.5, trigger_size: int = 3):
         poison_frac = config.poison_frac if poison_frac is None else poison_frac
+        self.trigger_value = trigger_value
+        self.trigger_size = trigger_size
         if poison_frac > 0:
-            dataset = self._poison(dataset, attacker_idx, target_class, poison_frac)
+            dataset = self._poison(dataset, attacker_idx, target_class,
+                                   poison_frac, trigger_value, trigger_size)
         self.attacker_idx = attacker_idx
         self.target_class = target_class
         super().__init__(dataset, config, bundle)
 
     @staticmethod
-    def _poison(dataset, attacker_idx: int, target_class: int, frac: float):
+    def _poison(dataset, attacker_idx: int, target_class: int, frac: float,
+                trigger_value: float = 2.5, trigger_size: int = 3):
         import dataclasses
 
         tx = np.array(dataset.train_x, copy=True)
@@ -63,7 +76,8 @@ class FedAvgRobustAPI(FedAvgAPI):
         # the padded layout), not of the padded length
         n_real = int(dataset.train_mask[attacker_idx].sum())
         n_poison = int(n_real * frac)
-        tx[attacker_idx, :n_poison] = stamp_trigger(tx[attacker_idx, :n_poison])
+        tx[attacker_idx, :n_poison] = stamp_trigger(
+            tx[attacker_idx, :n_poison], trigger_value, trigger_size)
         ty[attacker_idx, :n_poison] = target_class
         return dataclasses.replace(dataset, train_x=tx, train_y=ty)
 
@@ -105,7 +119,8 @@ class FedAvgRobustAPI(FedAvgAPI):
         FedAvgRobustAggregator's backdoor eval on the targeted task)."""
         ds = self.dataset
         keep = ds.test_y != self.target_class  # non-target samples only
-        x = stamp_trigger(np.asarray(ds.test_x)[keep])
+        x = stamp_trigger(np.asarray(ds.test_x)[keep],
+                          self.trigger_value, self.trigger_size)
         y = np.full(x.shape[0], self.target_class, ds.test_y.dtype)
         m = np.asarray(ds.test_mask)[keep]
         # the jitted eval ceil-pads internally, no host-side padding needed

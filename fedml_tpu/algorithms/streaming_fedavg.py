@@ -286,7 +286,11 @@ class StreamingFedAvgAPI(FedAvgAPI):
                     else float(streamed))
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
         res = LocalResult(stacked, jnp.stack(losses), jnp.stack(taus))
-        self.variables, self.server_state, train_loss = self._finish_jit(
+        out = self._finish_jit(
             self.variables, self.server_state, res,
             jnp.asarray(counts, jnp.float32), rk)
+        # fedlens rides the shared _finish_round (norm + align; no
+        # loss_delta — the sequential trainer reports one mean loss)
+        self.variables, self.server_state, train_loss = self._lens_absorb(
+            round_idx, out, np.asarray(sampled, np.int64), counts > 0)
         return train_loss if self.config.async_rounds else float(train_loss)

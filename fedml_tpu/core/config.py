@@ -370,9 +370,27 @@ class FedConfig:
     # further behind the emitted version). 0 = rule off (sync runs keep
     # their stale_spike rule; async launchers arm this one).
     health_version_lag: float = 0.0
+    # fedlens learning-signal attribution rules (require --lens on to have
+    # data): warn when THIS round's update-norm / drift sketch delta p99
+    # reaches the threshold, carrying the round's top-k suspect client
+    # ids. 0 = rule off. The aligned_suspects critical rule needs no knob:
+    # it arms whenever the lens surfaces suspects.
+    health_update_norm: float = 0.0
+    health_drift: float = 0.0
     # escalate-to-raise: any critical health event raises
     # FederationHealthError AFTER its pulse snapshot is written
     health_escalate: bool = False
+    # fedlens in-program learning-signal telemetry (obs/lens, DESIGN.md
+    # §22): 'on' arms per-client update-norm / loss-delta / alignment
+    # reductions INSIDE the round programs (output-only — aggregation is
+    # bit-identical to 'off', pinned by tests/test_lens.py) and feeds the
+    # pulse plane's `learning` block, the profiler's update_norm/drift
+    # sketch lanes, and the attributed watchdog rules. 'off' (default)
+    # builds the exact lens-free programs.
+    lens: str = "off"
+    # how many ranked suspect client ids each learning block / watchdog
+    # event / incident bundle carries
+    lens_topk: int = 5
     # fedflight anomaly-triggered flight recorder (obs/flight, DESIGN.md
     # §21): when set, the process retains the last --flight_window rounds
     # of FULL-rate round spans (a second per-rank ring beside the sampled
@@ -592,6 +610,19 @@ class FedConfig:
             raise ValueError(
                 f"health_version_lag must be >= 0, got "
                 f"{self.health_version_lag}")
+        if self.lens not in ("off", "on"):
+            raise ValueError(
+                f"lens must be 'off' or 'on', got {self.lens!r}")
+        if self.lens_topk < 1:
+            raise ValueError(
+                f"lens_topk must be >= 1, got {self.lens_topk}")
+        if self.health_update_norm < 0:
+            raise ValueError(
+                f"health_update_norm must be >= 0, got "
+                f"{self.health_update_norm}")
+        if self.health_drift < 0:
+            raise ValueError(
+                f"health_drift must be >= 0, got {self.health_drift}")
         from fedml_tpu.core.compression import parse_codec
 
         parse_codec(self.wire_codec)   # raises on an unknown codec spec
@@ -826,10 +857,29 @@ def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Argum
                    help="watchdog: per-round staleness-sketch delta p99 "
                         "(versions behind) that warns; monotonic growth "
                         "escalates to critical (0 = rule off)")
+    p.add_argument("--health_update_norm", type=float,
+                   default=defaults.health_update_norm,
+                   help="watchdog (fedlens): per-round update-norm sketch "
+                        "delta p99 that warns with suspect client ids "
+                        "(0 = rule off; needs --lens on)")
+    p.add_argument("--health_drift", type=float,
+                   default=defaults.health_drift,
+                   help="watchdog (fedlens): per-round drift sketch delta "
+                        "p99 (1 - cosine vs aggregate) that warns with "
+                        "suspect client ids (0 = rule off; needs --lens on)")
     p.add_argument("--health_escalate", type=lambda s: bool(int(s)),
                    default=defaults.health_escalate,
                    help="raise FederationHealthError on critical health "
                         "events (0|1; snapshot is written first)")
+    p.add_argument("--lens", type=str, choices=("off", "on"),
+                   default=defaults.lens,
+                   help="fedlens in-program learning-signal telemetry: "
+                        "per-client update norm / loss delta / alignment "
+                        "computed inside the round programs (output-only; "
+                        "aggregation bit-identical to off)")
+    p.add_argument("--lens_topk", type=int, default=defaults.lens_topk,
+                   help="ranked suspect client ids carried by each "
+                        "learning block / attributed watchdog event")
     p.add_argument("--flight_dir", type=str, default=None,
                    help="fedflight black-box recorder: retain the last "
                         "--flight_window rounds at FULL rate and dump a "

@@ -94,6 +94,10 @@ class FedAVGAggregator:
         # lossy-wire run can assert no upload was aggregated twice
         # (uploads_accepted == rounds x workers under full participation)
         self.uploads_accepted = 0
+        #: fedlens per-round stats ({"workers", "update_norm", "align"}),
+        #: set by aggregate() when the lens is armed; the server manager
+        #: drains it into the pulse plane after each round closes
+        self.lens_stats: Optional[dict] = None
         self._eval = make_eval_fn(bundle, get_task(dataset.task, dataset.class_num)) if bundle is not None and dataset is not None else None
         if getattr(config, "cohort_policy", "uniform") != "uniform":
             LOG.warning(
@@ -127,8 +131,19 @@ class FedAVGAggregator:
             # the mesh path's all-fail behavior (tests/test_failures.py)
             self.model_dict.clear()
             return self.variables
+        old = self.variables
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *[self.model_dict[i] for i in order])
         self.variables = tree_weighted_mean(stacked, counts)
+        from fedml_tpu.obs.lens import host_lens_stats, lens_enabled
+
+        if lens_enabled():
+            # the batch server still holds every member tree here, so the
+            # full per-worker lens (norm + cosine vs the aggregate's raw
+            # update) comes for free at round close
+            self.lens_stats = dict(
+                host_lens_stats(old, [self.model_dict[i] for i in order],
+                                self.variables),
+                workers=list(order))
         self.model_dict.clear()
         return self.variables
 
@@ -181,6 +196,8 @@ class StreamingFedAVGAggregator(FedAVGAggregator):
         #: high-water mark of simultaneously held out-of-order uploads
         #: (deterministic mode) — the measured O(1) evidence
         self.stream_peak_held = 0
+        #: fedlens fold-time accumulation (norm-only; module docstring)
+        self._lens_acc: dict = {"workers": [], "update_norm": []}
 
     @property
     def stream_nbytes(self) -> int:
@@ -190,6 +207,16 @@ class StreamingFedAVGAggregator(FedAVGAggregator):
         if index in self.model_dict:
             self.duplicate_uploads += 1
             return
+        from fedml_tpu.obs.lens import host_lens_stats, lens_enabled
+
+        if lens_enabled():
+            # norm-only at fold time: the O(1) fold never buffers the
+            # member trees an alignment basis needs (self.variables is
+            # still the round's broadcast model until aggregate())
+            st = host_lens_stats(self.variables, [model_params])
+            acc = self._lens_acc
+            acc["workers"].append(int(index))
+            acc["update_norm"].append(float(st["update_norm"][0]))
         self._stream.add(index, model_params, float(sample_num))
         self.stream_peak_held = max(self.stream_peak_held,
                                     self._stream.peak_held)
@@ -202,6 +229,14 @@ class StreamingFedAVGAggregator(FedAVGAggregator):
         out = self._stream.finalize(self.variables)
         self._stream = self._stream_cls()
         self.model_dict.clear()
+        if self._lens_acc["workers"]:
+            import numpy as _np
+
+            self.lens_stats = {
+                "workers": self._lens_acc["workers"],
+                "update_norm": _np.asarray(self._lens_acc["update_norm"]),
+                "align": None}
+            self._lens_acc = {"workers": [], "update_norm": []}
         if out is not None:
             self.variables = out
         # None = zero-weight round: the elastic no-op, like the batch path
@@ -636,6 +671,21 @@ class FedAvgEdgeServerManager(ServerManager):
             metrics = self.aggregator.test_on_server_for_all_clients(self.round_idx)
         pulse = pulse_if_enabled()
         if pulse is not None:
+            # fedlens drain: per-worker upload stats the aggregator computed
+            # at round close, attributed to each worker's assigned logical
+            # clients (the id space every lens consumer ranks in) — fed
+            # BEFORE on_round so this round's snapshot folds them
+            ls = getattr(self.aggregator, "lens_stats", None)
+            self.aggregator.lens_stats = None
+            if ls:
+                al = ls.get("align")
+                for j, w in enumerate(ls["workers"]):
+                    ids = self._assignment_map.get(w) or []
+                    if ids:
+                        pulse.observe_lens(
+                            ids, self.round_idx,
+                            update_norm=float(ls["update_norm"][j]),
+                            align=None if al is None else float(al[j]))
             # one pulse snapshot per completed round, from the server (the
             # only rank that sees the whole broadcast->aggregate path); its
             # stale-upload/liveness counters ride the wire lane so the

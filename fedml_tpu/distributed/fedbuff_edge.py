@@ -102,6 +102,14 @@ MSG_ARG_KEY_PEER = "peer_rank"
 DEFAULT_PROBE_SEC = 3.0
 
 
+def _flat64(tree) -> np.ndarray:
+    """Flatten a host tree to one f64 vector (fedlens norm/cosine basis;
+    leaf order is the canonical jax.tree order, so two trees of the same
+    structure flatten comparably)."""
+    return np.concatenate([np.asarray(l, np.float64).ravel()
+                           for l in jax.tree.leaves(tree)])
+
+
 def _probe_interval(config) -> float:
     from fedml_tpu.comm.reliable import retry_budget_s
 
@@ -212,6 +220,12 @@ class FedBuffEdgeServerManager(ServerManager):
         self._probe_sec = _probe_interval(cfg)
         self._probe_timer: Optional[threading.Timer] = None
         self._emit_t0 = time.perf_counter()
+        #: fedlens alignment basis: the LAST emitted server update
+        #: (flattened f64) — an async fold has no same-round cohort to
+        #: align against, so each upload's delta is scored against the
+        #: server's most recent direction instead (None until the first
+        #: emission: norms-only, like the streaming sync fold)
+        self._last_emit_delta: Optional[np.ndarray] = None
         if self.deterministic:
             from fedml_tpu.distributed.base_framework import require_injectable
 
@@ -368,6 +382,23 @@ class FedBuffEdgeServerManager(ServerManager):
                     getattr(leaf, "nbytes", 8)
                     for leaf in jax.tree.leaves(delta))),
                 staleness=rec["staleness"])
+            from fedml_tpu.obs.lens import lens_enabled
+
+            if lens_enabled():
+                # fedlens per-fold: the upload IS a raw update delta —
+                # norm directly, cosine vs the last emitted server update
+                u = _flat64(delta)
+                nrm = float(np.linalg.norm(u))
+                align = None
+                m = self._last_emit_delta
+                if m is not None and m.size == u.size:
+                    align = float(u @ m) / max(
+                        nrm * float(np.linalg.norm(m)), 1e-12)
+                ids = self._assignment_map.get(worker) or []
+                if ids:
+                    # fedlint: disable=check-then-act
+                    pulse.observe_lens(ids, self.buffer.version,
+                                       update_norm=nrm, align=align)
         if self.buffer.ready:
             self._emit()
 
@@ -391,8 +422,13 @@ class FedBuffEdgeServerManager(ServerManager):
     # -- version emission --------------------------------------------------
 
     def _emit(self) -> None:
-        params, rec = self.buffer.emit(self.aggregator.variables)
+        old = self.aggregator.variables
+        params, rec = self.buffer.emit(old)
         self.aggregator.variables = params
+        from fedml_tpu.obs.lens import lens_enabled
+
+        if lens_enabled():
+            self._last_emit_delta = _flat64(params) - _flat64(old)
         v_idx = self.buffer.versions_emitted - 1   # 0-based, like rounds
         metrics = None
         if (v_idx % self.args.frequency_of_the_test == 0

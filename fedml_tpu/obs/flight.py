@@ -61,6 +61,8 @@ Bundle layout (``incident-<id>/``)::
     ring-rank<r>.jsonl  per-rank full-rate flight-ring dump
     trace-merged.jsonl  all rings merged on the wall-µs timebase
     rounds.jsonl        windowed round records + per-round lane deltas
+                        (+ the fedlens ``learning`` lane — suspects and
+                        all — when ``--lens on`` armed the run)
     pulse-tail.jsonl    the raw recent pulse snapshots (fedtop shape)
     watchdog.json       the structured watchdog.incident() view
     cost.json/plan.json fedcost tables / fedplan decisions, when present
@@ -362,7 +364,7 @@ class FlightRecorder:
                     deltas[ns] = d
             prev_lanes = lanes
             health = snap.get("health") or {}
-            out.append({
+            rec = {
                 "round": snap.get("round"), "ts_ms": snap.get("ts_ms"),
                 "source": snap.get("source"), "loss": snap.get("loss"),
                 "round_ms": snap.get("round_ms"),
@@ -370,7 +372,14 @@ class FlightRecorder:
                 "lane_deltas": deltas,
                 "state": health.get("state"),
                 "events": health.get("events") or [],
-            })
+            }
+            # fedlens lane: keep the per-round suspect attribution in the
+            # compact records too, so fedpost's suspects section works from
+            # rounds.jsonl alone (pulse-tail.jsonl carries the full snaps)
+            learning = snap.get("learning")
+            if learning is not None:
+                rec["learning"] = learning
+            out.append(rec)
         return out
 
     @staticmethod

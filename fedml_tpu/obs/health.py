@@ -54,6 +54,23 @@ rule                severity  fires when
                               signature (a bounded-but-high lag is a warn;
                               an unbounded one means the staleness decay
                               is no longer keeping the fold mass current)
+``update_norm_spike``  warn   fedlens: THIS round's update-norm sketch
+                              delta p99 reaches ``--health_update_norm``
+                              (>0 arms it) — some client pushed an update
+                              far outside the cohort's norm envelope; the
+                              event carries the round's top-k suspect ids
+``client_drift``    warn      fedlens: THIS round's drift sketch delta p99
+                              (1 - cosine vs the round aggregate) reaches
+                              ``--health_drift`` (>0 arms it) — part of
+                              the cohort is pulling away from the
+                              federation's direction; carries suspect ids
+``aligned_suspects``  critical  fedlens (always armed when the lens is on):
+                              a ranked suspect is ANTI-aligned (cosine <=
+                              ``lens.ANTI_ALIGN``) with an update norm at
+                              or above this round's cohort median — the
+                              opposite-direction-with-authority signature
+                              of a poisoned/backdoored client; the event
+                              names the suspect ids
 ==================  ========  =============================================
 
 Counter rules are DELTA rules: the watchdog tracks the previous round's
@@ -101,6 +118,7 @@ class HealthWatchdog:
     def __init__(self, *, loss_limit: float = 0.0,
                  stall_sec: Optional[float] = None, stale_spike: int = 8,
                  skew: float = 4.0, version_lag: float = 0.0,
+                 update_norm: float = 0.0, drift: float = 0.0,
                  escalate: bool = False,
                  history: int = 256):
         self.loss_limit = float(loss_limit or 0.0)
@@ -108,6 +126,8 @@ class HealthWatchdog:
         self.stale_spike = int(stale_spike or 0)
         self.skew = float(skew or 0.0)
         self.version_lag = float(version_lag or 0.0)
+        self.update_norm = float(update_norm or 0.0)
+        self.drift = float(drift or 0.0)
         self.escalate = bool(escalate)
         #: last staleness-delta p99 + current monotonic-growth streak
         self._lag_prev: Optional[float] = None
@@ -148,9 +168,15 @@ class HealthWatchdog:
         snapshot carrying these events has been persisted."""
         events: list = []
 
-        def add(rule: str, severity: str, detail: str) -> None:
-            events.append({"round": int(round_idx), "rule": rule,
-                           "severity": severity, "detail": detail})
+        def add(rule: str, severity: str, detail: str,
+                suspects: Optional[list] = None) -> None:
+            ev = {"round": int(round_idx), "rule": rule,
+                  "severity": severity, "detail": detail}
+            if suspects:
+                # only the fedlens attribution rules carry this key, so
+                # every pre-lens event dict stays byte-identical
+                ev["suspects"] = [int(s) for s in suspects]
+            events.append(ev)
 
         if loss is not None:
             if not math.isfinite(loss):
@@ -219,6 +245,51 @@ class HealthWatchdog:
                         f"health_version_lag {self.version_lag:g}"
                         + (f"; grew {self._lag_growth} snapshots in a row "
                            "(monotonic divergence)" if monotone else ""))
+        # fedlens attribution rules: per-round deltas of the learning
+        # lanes (the pulse plane feeds this round's sketch deltas, same as
+        # straggler_skew / version_lag) plus the ranked suspects the lens
+        # folded for this round — so every event NAMES who to look at
+        lens_info = (profile or {}).get("lens") or {}
+        suspects = lens_info.get("suspects") or []
+        sus_ids = [s.get("client") for s in suspects]
+        if self.update_norm > 0.0 and profile:
+            sk = (profile.get("sketches") or {}).get("update_norm") or {}
+            p99 = sk.get("p99")
+            if (p99 is not None and sk.get("count", 0) > 0
+                    and p99 >= self.update_norm):
+                add("update_norm_spike", "warn",
+                    f"update-norm delta p99 {p99:g} >= health_update_norm "
+                    f"{self.update_norm:g}", suspects=sus_ids)
+        if self.drift > 0.0 and profile:
+            sk = (profile.get("sketches") or {}).get("drift") or {}
+            p99 = sk.get("p99")
+            if (p99 is not None and sk.get("count", 0) > 0
+                    and p99 >= self.drift):
+                add("client_drift", "warn",
+                    f"drift delta p99 {p99:g} >= health_drift "
+                    f"{self.drift:g}", suspects=sus_ids)
+        if suspects:
+            # always armed when the lens surfaces suspects: anti-aligned
+            # (cosine <= ANTI_ALIGN) AND norm at/above this round's cohort
+            # median (skip the guard when the round carries no norm p50) —
+            # an update pushing hard in the opposite direction
+            from fedml_tpu.obs.lens import ANTI_ALIGN
+
+            sk = ((profile or {}).get("sketches") or {}).get(
+                "update_norm") or {}
+            p50 = sk.get("p50")
+            bad = [s for s in suspects
+                   if s.get("align") is not None
+                   and s["align"] <= ANTI_ALIGN
+                   and (p50 is None or s.get("norm", 0.0) >= p50)]
+            if bad:
+                add("aligned_suspects", "critical",
+                    f"{len(bad)} anti-aligned high-norm suspect(s) — "
+                    "client(s) "
+                    + ", ".join(str(int(b["client"])) for b in bad)
+                    + f" push against the aggregate (cosine <= {ANTI_ALIGN:g}"
+                    " at/above the cohort's median update norm)",
+                    suspects=[b["client"] for b in bad])
         if profile:
             cur_dropped = int(profile.get("dropped_ids", 0) or 0)
             delta = cur_dropped - self._prev_dropped

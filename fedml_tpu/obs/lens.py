@@ -1,0 +1,276 @@
+"""fedlens: in-program learning-signal telemetry with per-client attribution.
+
+The observability plane to date watches only *systems* signals — time,
+wire, MFU — and the watchdog's model-quality rules are scalar
+(``nan_loss``/``divergent_loss`` on the round-mean loss), so a single
+poisoned or diverging client is invisible until it wrecks the global
+model. The lens closes that gap with three per-client learning signals
+computed INSIDE the round programs, as cheap reductions over values the
+round already materializes (no second pass over params, no extra host
+sync):
+
+- ``update_norm`` — L2 norm of the client's raw local update
+  (post-training params minus the broadcast params, f32);
+- ``loss_delta`` — first-epoch mean loss minus last-epoch mean loss
+  (positive = the client's local training is still making progress;
+  zero by construction when ``epochs == 1``);
+- ``align`` — cosine of the client's raw update against the
+  counts-weighted mean update of the round cohort (the fedavg
+  pseudo-gradient). The exported ``drift`` lane is ``1 - align``
+  (0 = perfectly aligned, 1 = orthogonal, 2 = anti-aligned).
+
+The alignment basis is deliberately the RAW weighted-mean update — not
+the post-``client_transform`` aggregate — so a robust-aggregation clip
+cannot hide the attacker from the very telemetry meant to catch it, and
+the definition is identical across the vmap, gather, grouped and packed
+round forms (the packed-vs-vmap parity test pins it at fedseg
+tolerance).
+
+Contracts (the tracer/pulse discipline, restated):
+
+- **off by default, one-global-read gate**: :func:`lens_enabled` is a
+  dict read; disabled call sites build the exact round programs they
+  always built (lens-ON adds output-only reductions, and the pinned
+  bit-identity tests hold lens-on == lens-off weights on sim and the
+  4-rank grpc harness);
+- **no host sync on async rounds**: the armed sim APIs stash the round's
+  lens DEVICE arrays and convert one round late under
+  ``--async_rounds`` (see ``FedAvgAPI._pulse_lens``);
+- **attribution, not just detection**: every consumer — the pulse
+  ``learning`` block, the three watchdog rules, the fedflight bundle,
+  fedpost/fedtop — carries the top-k suspect *logical client ids*.
+
+Privacy note: suspect ids are LOGICAL ids (the federation's own client
+index space). The lens exports norms/cosines/loss scalars only — never
+update contents — but a per-client scalar stream is still a membership
+side channel; deployments that treat client identity as sensitive
+should leave ``--lens off`` (the default) or strip the ``learning``
+block before shipping pulse streams off-box (docs/DESIGN.md §22).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "ANTI_ALIGN", "LENS_LANES", "configure", "configure_from", "fold_rows",
+    "host_lens_stats", "lens_enabled", "lens_topk", "packed_lens",
+    "rank_suspects", "reset", "session_stats", "stacked_lens",
+]
+
+#: process-lifetime stats for the conftest ``[t1] lens:`` session line
+#: (NEVER reset — they describe the session, not one run)
+_SESSION = {"folds": 0, "clients": 0, "suspects": 0}
+
+#: the lens's two ClientProfiler sketch lanes (per-round deltas feed the
+#: watchdog's update_norm_spike / client_drift rules)
+LENS_LANES = ("update_norm", "drift")
+
+#: cosine at or below which an update counts as anti-aligned with the
+#: round aggregate — the aligned_suspects signature (drift >= 1.2)
+ANTI_ALIGN = -0.2
+
+_EPS = 1e-12
+
+_STATE = {"on": False, "topk": 5}
+
+
+def lens_enabled() -> bool:
+    """Hot-path gate: one dict read; False = every builder compiles the
+    exact lens-free program it always did."""
+    return _STATE["on"]
+
+
+def lens_topk() -> int:
+    return _STATE["topk"]
+
+
+def configure(on: bool = False, topk: int = 5) -> None:
+    """Arm/disarm the lens process-wide. Arm BEFORE building an API (the
+    round programs snapshot the flag at first trace, like the tracer)."""
+    _STATE["on"] = bool(on)
+    _STATE["topk"] = max(int(topk or 5), 1)
+
+
+_NO_LENS = object()
+
+
+def configure_from(config) -> bool:
+    """Configure from a FedConfig-shaped object (chained from
+    ``live.configure_from`` so every entry point makes the one call).
+    ``lens`` is authoritative when present: ``"off"`` disarms a lens left
+    on by an earlier run in the process; a config without the attribute
+    leaves the state untouched (direct ``configure()`` callers)."""
+    mode = getattr(config, "lens", _NO_LENS)
+    if mode is _NO_LENS:
+        return lens_enabled()
+    configure(str(mode) == "on",
+              topk=int(getattr(config, "lens_topk", 5) or 5))
+    return lens_enabled()
+
+
+def reset() -> None:
+    configure(False)
+
+
+def session_stats() -> dict:
+    """Process-lifetime lens stats (the conftest ``[t1] lens:`` session
+    line): round folds performed, client observations folded, suspects
+    ranked."""
+    return dict(_SESSION)
+
+
+# -- device-side helpers (jit-pure; imported inside round builders) ----------
+
+def stacked_lens(variables0, res, weights) -> dict:
+    """Full lens dict from a stacked cohort result (the vmap / gather /
+    grouped round forms): ``res.variables`` leaves are ``[cohort, ...]``.
+    Returns ``{"update_norm", "align"[, "loss_delta"]}``, each
+    ``[cohort]`` f32. Pure output-only reductions: nothing here feeds the
+    aggregate, so an armed program computes bit-identical weights."""
+    import jax
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    upd = jax.tree.leaves(jax.tree.map(
+        lambda s, v: s.astype(f32) - v.astype(f32)[None],
+        res.variables["params"], variables0["params"]))
+    n = upd[0].shape[0]
+    flat = [u.reshape((n, -1)) for u in upd]
+    n2 = sum(jnp.sum(u * u, axis=1) for u in flat)
+    w = jnp.asarray(weights, f32)
+    tot = jnp.maximum(jnp.sum(w), _EPS)
+    mean = [jnp.tensordot(w / tot, u, axes=1) for u in flat]
+    m2 = sum(jnp.sum(m * m) for m in mean)
+    dots = sum(jnp.tensordot(u, m, axes=1) for u, m in zip(flat, mean))
+    norm = jnp.sqrt(n2)
+    out = {"update_norm": norm,
+           "align": dots / jnp.maximum(norm * jnp.sqrt(m2), _EPS)}
+    first = getattr(res, "first_loss", None)
+    if first is not None:
+        out["loss_delta"] = first.astype(f32) - res.train_loss.astype(f32)
+    return out
+
+
+def packed_lens(upd_stack, l_first, l_last, member_w) -> dict:
+    """Full lens dict from the packed forms' emitted member stacks:
+    ``upd_stack`` leaves carry the member axes in front (``[L, k, ...]``
+    joint/lane form), ``member_w`` has exactly those axes. Same
+    definitions as :func:`stacked_lens` — the alignment basis is the
+    member-weighted mean of the raw emitted updates — so packed and vmap
+    agree to accumulation-order tolerance. All outputs are flattened to
+    one member axis in ``member_pos`` order (host side maps them back to
+    logical ids)."""
+    import jax
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    n = int(np.prod(member_w.shape))
+    flat = [u.astype(f32).reshape((n, -1))
+            for u in jax.tree.leaves(upd_stack)]
+    n2 = sum(jnp.sum(u * u, axis=1) for u in flat)
+    w = member_w.astype(f32).reshape(-1)
+    tot = jnp.maximum(jnp.sum(w), _EPS)
+    mean = [jnp.tensordot(w / tot, u, axes=1) for u in flat]
+    m2 = sum(jnp.sum(m * m) for m in mean)
+    dots = sum(jnp.tensordot(u, m, axes=1) for u, m in zip(flat, mean))
+    norm = jnp.sqrt(n2)
+    return {"update_norm": norm,
+            "align": dots / jnp.maximum(norm * jnp.sqrt(m2), _EPS),
+            "loss_delta": (l_first - l_last).astype(f32).reshape(-1)}
+
+
+# -- host-side helpers (edge servers; numpy trees) ---------------------------
+
+def host_lens_stats(variables0, member_trees, aggregate=None) -> dict:
+    """Edge-server lens over host numpy trees: per-member raw-update L2
+    norms, plus cosine vs the aggregate's update when the server still
+    holds one (the batch aggregator; the O(1) streaming fold keeps
+    norm-only — it never buffers the per-member trees an alignment basis
+    needs). The aggregate is the counts-weighted mean of member params, so
+    ``aggregate - variables0`` IS the weighted-mean raw update — the same
+    alignment basis the device paths use."""
+    import jax
+
+    def flat(t):
+        return np.concatenate([np.asarray(l, np.float64).ravel()
+                               for l in jax.tree.leaves(t)])
+
+    base = flat(variables0)
+    ups = [flat(t) - base for t in member_trees]
+    norm = np.array([np.linalg.norm(u) for u in ups], np.float64)
+    out = {"update_norm": norm, "align": None}
+    if aggregate is not None:
+        m = flat(aggregate) - base
+        mn = float(np.linalg.norm(m))
+        out["align"] = np.array(
+            [float(u @ m) / max(float(n) * mn, _EPS)
+             for u, n in zip(ups, norm)], np.float64)
+    return out
+
+
+# -- host-side folding / ranking ---------------------------------------------
+
+def _broadcast(v, ids: np.ndarray) -> Optional[np.ndarray]:
+    if v is None:
+        return None
+    return np.broadcast_to(np.asarray(v, np.float64), ids.shape).astype(
+        np.float64)
+
+
+def fold_rows(rows: list, k: int) -> dict:
+    """Merge one round's lens feed rows (sim stash + edge per-upload
+    stats) into the pulse snapshot's ``learning`` block: client count and
+    the ranked top-``k`` suspects. A client observed twice in one round
+    (a re-upload) keeps its worst (highest-drift, then highest-norm)
+    observation."""
+    ids = np.concatenate([r["ids"] for r in rows])
+    norm = np.concatenate([_broadcast(r["update_norm"], r["ids"])
+                           for r in rows])
+    align = (np.concatenate(
+        [(_broadcast(r.get("align"), r["ids"])
+          if r.get("align") is not None
+          else np.full(r["ids"].shape, np.nan)) for r in rows]))
+    delta = (np.concatenate(
+        [(_broadcast(r.get("loss_delta"), r["ids"])
+          if r.get("loss_delta") is not None
+          else np.full(r["ids"].shape, np.nan)) for r in rows]))
+    out = {"clients": int(np.unique(ids).size),
+           "suspects": rank_suspects(ids, norm, align, delta, k)}
+    _SESSION["folds"] += 1
+    _SESSION["clients"] += out["clients"]
+    _SESSION["suspects"] += len(out["suspects"])
+    return out
+
+
+def rank_suspects(ids, norm, align, loss_delta, k: int) -> list:
+    """Deterministic suspicion ranking: drift (descending) first — an
+    anti-aligned update is the strongest poison signal — then update norm
+    (descending), then id (ascending) so ties never reorder between runs.
+    Clients without an alignment basis (edge streaming folds) rank by
+    norm among themselves, below any drifting client."""
+    ids = np.asarray(ids, np.int64)
+    norm = np.asarray(norm, np.float64)
+    align = np.asarray(align, np.float64)
+    delta = np.asarray(loss_delta, np.float64)
+    drift = np.where(np.isnan(align), -np.inf, 1.0 - align)
+    # lexsort: LAST key is primary
+    order = np.lexsort((ids, -norm, -drift))
+    out, seen = [], set()
+    for j in order:
+        cid = int(ids[j])
+        if cid in seen:
+            continue
+        seen.add(cid)
+        s = {"client": cid, "norm": round(float(norm[j]), 6)}
+        if np.isfinite(align[j]):
+            s["align"] = round(float(align[j]), 6)
+            s["drift"] = round(float(drift[j]), 6)
+        if np.isfinite(delta[j]):
+            s["loss_delta"] = round(float(delta[j]), 6)
+        out.append(s)
+        if len(out) >= int(k):
+            break
+    return out

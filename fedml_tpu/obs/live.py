@@ -197,6 +197,10 @@ class PulsePlane:
         self._peak_resolved = False
         #: previous round-boundary sketch copies, for the per-round deltas
         self._prev_sketches: dict = {}
+        #: fedlens rows accumulated since the last round boundary (sim
+        #: stash conversions + edge per-upload stats), folded into the
+        #: snapshot's ``learning`` block then cleared
+        self._lens_rows: list = []
 
     # -- feeds ---------------------------------------------------------------
 
@@ -224,6 +228,26 @@ class PulsePlane:
             self.profiler.observe_wire(upload_ms=train_ms,
                                        payload_bytes=upload_bytes,
                                        staleness=float(staleness))
+
+    def observe_lens(self, client_ids, round_idx: int, *, update_norm,
+                     align=None, loss_delta=None) -> None:
+        """fedlens per-client learning-signal feed: per-id update norms
+        plus (when the path computes them) cosine alignment vs the round
+        aggregate and first-to-last-epoch loss deltas. The sim paradigms
+        route their device stash here one boundary later under
+        ``--async_rounds``; the edge servers feed per-upload stats. Rows
+        accumulate until the next :meth:`on_round` folds them into the
+        snapshot's ``learning`` block (obs/lens.fold_rows)."""
+        ids = np.atleast_1d(np.asarray(client_ids, np.int64))
+        if ids.size == 0:
+            return
+        if self.profiler is not None:
+            drift = None if align is None else 1.0 - np.asarray(
+                align, np.float64)
+            self.profiler.observe_lens(ids, round_idx,
+                                       update_norm=update_norm, drift=drift)
+        self._lens_rows.append({"ids": ids, "update_norm": update_norm,
+                                "align": align, "loss_delta": loss_delta})
 
     def observe_stale(self, rounds_behind: int) -> None:
         """Stale-contribution feed (the deadline-closed late-upload path):
@@ -260,6 +284,19 @@ class PulsePlane:
             # a paradigm whose dataset/plan doesn't fit the cohort contract
             # (vertical splits etc.): keep the round snapshot, skip per-client
             ids = None
+        try:
+            # fedlens stash drain: the lens-armed APIs hand over the
+            # round's per-client device stats ONE boundary late under
+            # async_rounds (no host sync on the round path); the stash
+            # carries its own round index + ids so the lag can never
+            # misattribute
+            pl = getattr(api, "_pulse_lens", None)
+            st = pl(round_idx) if pl is not None else None
+            if st is not None:
+                lens_round, lens_ids, lens_stats = st
+                self.observe_lens(lens_ids, lens_round, **lens_stats)
+        except Exception:
+            pass
         host_loss = (float(loss)
                      if isinstance(loss, (int, float))
                      and not isinstance(loss, bool) else None)
@@ -343,6 +380,25 @@ class PulsePlane:
                 profile["sketches"] = {
                     lane: s["round"] for lane, s in sketches.items()}
 
+        # fedlens learning block: fold the rows fed since the last
+        # boundary (rank + dedupe, obs/lens.fold_rows). ABSENT — not null —
+        # when no lens row arrived, so lens-off snapshots (and every
+        # committed golden) stay byte-identical
+        learning = None
+        if self._lens_rows:
+            from fedml_tpu.obs import lens as _lens
+
+            try:
+                learning = _lens.fold_rows(self._lens_rows,
+                                           _lens.lens_topk())
+            except Exception:
+                learning = None
+            self._lens_rows = []
+            if learning is not None and profile is not None:
+                # the watchdog's attribution rules read the suspects from
+                # the profile view it is handed (same round, same fold)
+                profile["lens"] = learning
+
         events: list = []
         health = None
         if self.watchdog is not None:
@@ -371,6 +427,8 @@ class PulsePlane:
                 "rates": rates, "lanes": lanes, "stage": stage,
                 "profile": profile, "sketches": sketches,
                 "cost": self._cost(round_ms), "health": health}
+        if learning is not None:
+            snap["learning"] = learning
         if self.exporter is not None:
             self.exporter.emit(snap)
         # fedflight: retain the round in the recorder's window AND — when
@@ -468,6 +526,7 @@ def configure(path: Optional[str] = None,
               loss_limit: float = 0.0,
               stall_sec: Optional[float] = None, stale_spike: int = 8,
               skew: float = 4.0, version_lag: float = 0.0,
+              update_norm: float = 0.0, drift: float = 0.0,
               escalate: bool = False) -> Optional[PulsePlane]:
     """(Re)build the process-wide plane. ``configure(None)`` disables it;
     ``configure(None, profile_store=True)`` builds a profiler-only plane
@@ -486,7 +545,9 @@ def configure(path: Optional[str] = None,
                 if profile_store else None)
     watchdog = HealthWatchdog(loss_limit=loss_limit, stall_sec=stall_sec,
                               stale_spike=stale_spike, skew=skew,
-                              version_lag=version_lag, escalate=escalate)
+                              version_lag=version_lag,
+                              update_norm=update_norm, drift=drift,
+                              escalate=escalate)
     # delta rules start from the registry's CURRENT totals: an earlier
     # federation's wire anomalies in this process are not this run's
     watchdog.baseline(default_registry().snapshot("wire"))
@@ -506,6 +567,12 @@ def configure_from(config) -> bool:
     Same semantics as the tracer: ``pulse_path`` is authoritative — unset
     DISABLES a plane left on by an earlier run in the process; only a
     config without the attribute at all leaves the plane untouched."""
+    # the lens arms from its own flag, not pulse_path: chained FIRST so
+    # --lens on is honored by every entry point even when no pulse stream
+    # is configured (the fedlint config-flag-drift contract)
+    from fedml_tpu.obs import lens as _lens
+
+    _lens.configure_from(config)
     path = getattr(config, "pulse_path", _NO_PULSE)
     if path is _NO_PULSE:
         return pulse_enabled()
@@ -521,6 +588,8 @@ def configure_from(config) -> bool:
               stale_spike=getattr(config, "health_stale_spike", 8),
               skew=getattr(config, "health_skew", 4.0),
               version_lag=getattr(config, "health_version_lag", 0.0),
+              update_norm=getattr(config, "health_update_norm", 0.0),
+              drift=getattr(config, "health_drift", 0.0),
               escalate=getattr(config, "health_escalate", False))
     return True
 

@@ -8,12 +8,13 @@ fairness question ("which clients never get sampled?") needs counts at the
 342k-client cross-device scale. :class:`ClientProfiler` is that store:
 
 - **array-backed, bounded**: one flat numpy array per field, indexed by
-  logical client id — no per-client Python objects, no dicts. 20 bytes per
+  logical client id — no per-client Python objects, no dicts. 28 bytes per
   client slot (EMA train-ms f32, cumulative upload bytes f64, participation
-  i32, last-seen round i32), grown geometrically to the highest observed id
+  i32, last-seen round i32, fedlens EMA update-norm + drift f32), grown
+  geometrically to the highest observed id
   and hard-capped at ``max_clients`` (ids beyond the cap are counted in
-  ``dropped``, never silently indexed). 342,477 clients ≈ 7 MB; the store
-  can never balloon past ``max_clients * 20`` bytes, and ``nbytes`` reports
+  ``dropped``, never silently indexed). 342,477 clients ≈ 10 MB; the store
+  can never balloon past ``max_clients * 28`` bytes, and ``nbytes`` reports
   the measured footprint so tests pin the bound instead of trusting it.
 - **paradigm-agnostic feed**: the simulation paradigms feed it from the
   traced ``FedAvgAPI.run_round`` wrapper (cohort ids from the round plan,
@@ -26,16 +27,19 @@ fairness question ("which clients never get sampled?") needs counts at the
   scheduling), :meth:`staleness` (FedBuff weighting),
   :meth:`participation_fairness` (sampling audits), and :meth:`aggregates`
   (the compact round-boundary summary the pulse stream and fedtop render).
-- **sketch lanes (fedsketch)**: alongside the per-client EMAs, four
+- **sketch lanes (fedsketch)**: alongside the per-client EMAs, six
   process-cumulative :class:`~fedml_tpu.obs.sketch.Sketch` lanes record
   the *distributions* the means hide — ``train_ms`` (per-client walls),
   ``upload_ms`` (broadcast→upload latency per contribution),
-  ``payload_bytes`` (per contribution), and ``staleness`` (rounds-behind
+  ``payload_bytes`` (per contribution), ``staleness`` (rounds-behind
   per contribution; the sync paths feed it from the stale-upload drop
   path, and the fedbuff async server writes every fold's version lag —
-  the signal the watchdog's ``version_lag`` rule reads). Fixed-memory and
-  mergeable across hosts; their measured bytes count into :attr:`nbytes`
-  so the store's bound stays honest.
+  the signal the watchdog's ``version_lag`` rule reads), and the fedlens
+  learning lanes ``update_norm`` / ``drift`` (per-client update L2 and
+  1 - cosine-vs-aggregate per contribution; their PER-ROUND deltas feed
+  the ``update_norm_spike`` / ``client_drift`` watchdog rules). Fixed-
+  memory and mergeable across hosts; their measured bytes count into
+  :attr:`nbytes` so the store's bound stays honest.
 
 Thread-safe (the edge server's handler thread and the sim loop may share
 one process-wide profiler); EMA uses a fixed ``ema_alpha`` so a client's
@@ -51,11 +55,14 @@ import numpy as np
 
 from fedml_tpu.obs.sketch import Sketch
 
-#: bytes per client slot across the four field arrays (f32 + f64 + 2*i32)
-BYTES_PER_CLIENT = 20
+#: bytes per client slot across the six field arrays
+#: (f32 + f64 + 2*i32 + 2*f32 fedlens EMAs)
+BYTES_PER_CLIENT = 28
 
-#: the profiler's distribution lanes, in pulse-snapshot render order
-SKETCH_LANES = ("train_ms", "upload_ms", "payload_bytes", "staleness")
+#: the profiler's distribution lanes, in pulse-snapshot render order (the
+#: last two are the fedlens learning lanes — obs/lens.LENS_LANES)
+SKETCH_LANES = ("train_ms", "upload_ms", "payload_bytes", "staleness",
+                "update_norm", "drift")
 
 
 def _gini(values: np.ndarray) -> float:
@@ -102,6 +109,9 @@ class ClientProfiler:
         self._upload_bytes = np.zeros(cap, np.float64)
         self._participation = np.zeros(cap, np.int32)
         self._last_seen = np.full(cap, -1, np.int32)
+        # fedlens learning EMAs (0 until a lens-armed round observes the id)
+        self._lens_norm = np.zeros(cap, np.float32)
+        self._lens_drift = np.zeros(cap, np.float32)
 
     def _ensure(self, n: int) -> None:
         if n <= self._cap:
@@ -111,7 +121,7 @@ class ClientProfiler:
             cap *= 2
         cap = min(cap, self.max_clients)
         for name in ("_ema_train_ms", "_upload_bytes", "_participation",
-                     "_last_seen"):
+                     "_last_seen", "_lens_norm", "_lens_drift"):
             old = getattr(self, name)
             new = (np.full(cap, -1, old.dtype) if name == "_last_seen"
                    else np.zeros(cap, old.dtype))
@@ -177,6 +187,55 @@ class ClientProfiler:
             if upload_bytes is not None:
                 self._upload_bytes[ids] += np.asarray(upload_bytes, np.float64)
 
+    def observe_lens(self, client_ids, round_idx: int, *, update_norm=None,
+                     drift=None) -> None:
+        """fedlens per-client learning-signal feed: per-id update L2 norms
+        and drift (1 - cosine vs the round aggregate), from a lens-armed
+        round (sim stash or edge per-upload stats). Seeds/blends the
+        per-client EMAs exactly like :meth:`observe` and adds every sample
+        to the ``update_norm`` / ``drift`` sketch lanes (whose per-round
+        deltas the watchdog's attribution rules read). Does NOT count as a
+        participation event — the round wrapper already recorded one."""
+        ids = np.atleast_1d(np.asarray(client_ids, np.int64))
+        if ids.size == 0:
+            return
+        with self._lock:
+            bad = (ids < 0) | (ids >= self.max_clients)
+            if bad.any():
+                self.dropped += int(bad.sum())
+                keep = ~bad
+                ids = ids[keep]
+                if update_norm is not None and np.ndim(update_norm):
+                    update_norm = np.asarray(update_norm)[keep]
+                if drift is not None and np.ndim(drift):
+                    drift = np.asarray(drift)[keep]
+                if ids.size == 0:
+                    return
+            self._ensure(int(ids.max()) + 1)
+            self._n = max(self._n, int(ids.max()) + 1)
+            self.last_round = max(self.last_round, int(round_idx))
+            a = self.ema_alpha
+            if update_norm is not None:
+                v = np.asarray(update_norm, np.float32)
+                first = self._lens_norm[ids] == 0.0
+                prev = self._lens_norm[ids]
+                self._lens_norm[ids] = np.where(
+                    first, v, (1.0 - a) * prev + a * v)
+                if np.ndim(v):
+                    self.sketches["update_norm"].add(v)
+                else:
+                    self.sketches["update_norm"].add(v, count=int(ids.size))
+            if drift is not None:
+                v = np.asarray(drift, np.float32)
+                first = self._lens_drift[ids] == 0.0
+                prev = self._lens_drift[ids]
+                self._lens_drift[ids] = np.where(
+                    first, v, (1.0 - a) * prev + a * v)
+                if np.ndim(v):
+                    self.sketches["drift"].add(v)
+                else:
+                    self.sketches["drift"].add(v, count=int(ids.size))
+
     def observe_wire(self, *, upload_ms=None, payload_bytes=None,
                      staleness=None) -> None:
         """Per-CONTRIBUTION sketch feed (no client attribution): the edge
@@ -211,6 +270,7 @@ class ClientProfiler:
         # snapshot section; taking the plain Lock again would deadlock)
         return int(self._ema_train_ms.nbytes + self._upload_bytes.nbytes
                    + self._participation.nbytes + self._last_seen.nbytes
+                   + self._lens_norm.nbytes + self._lens_drift.nbytes
                    + sum(sk.nbytes for sk in self.sketches.values()))
 
     def sketch_summaries(self) -> dict:

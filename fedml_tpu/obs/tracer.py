@@ -629,10 +629,12 @@ def reset() -> None:
         _SAMPLE_SEED = 0
         _TRACERS.clear()
     from fedml_tpu.obs import flight as _flight
+    from fedml_tpu.obs import lens as _lens
     from fedml_tpu.obs import live as _live
 
     _live.reset()
     _flight.reset()
+    _lens.reset()
     import sys
 
     packed = sys.modules.get("fedml_tpu.parallel.packed")

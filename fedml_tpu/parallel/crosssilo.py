@@ -51,6 +51,7 @@ def make_crosssilo_round(
     client_transform: Callable | None = None,
     reduce_extras: Callable | None = None,
     server_update: Callable | None = None,
+    lens: bool = False,
 ):
     """Build the jitted cross-silo round function.
 
@@ -82,7 +83,8 @@ def make_crosssilo_round(
     server_state / rng are replicated.
     """
 
-    finish = _make_mesh_finish(axis, client_transform, reduce_extras, server_update)
+    finish = _make_mesh_finish(axis, client_transform, reduce_extras,
+                               server_update, lens=lens)
 
     def shard_fn(variables, server_state, cx, cy, cm, counts, keys, rng):
         variables0 = variables  # replicated original (all-failed fallback)
@@ -98,16 +100,18 @@ def make_crosssilo_round(
         )
         return finish(variables0, variables, server_state, res, counts, rng)
 
+    out_specs = ((P(), P(), P(), P(axis)) if lens else (P(), P(), P()))
     mapped = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=out_specs,
     )
     return jax.jit(mapped)
 
 
-def _make_mesh_finish(axis, client_transform, reduce_extras, server_update):
+def _make_mesh_finish(axis, client_transform, reduce_extras, server_update,
+                      lens: bool = False):
     """The shared post-local-training tail of a mesh round: per-client hook →
     weighted psum mean → extra reductions → loss → server hook → elastic
     all-failed rollback. One definition so the plain and grouped round
@@ -131,6 +135,34 @@ def _make_mesh_finish(axis, client_transform, reduce_extras, server_update):
         loss = jax.lax.psum(jnp.sum(res.train_loss * w), axis) / denom
         new_vars, new_state = apply_server_and_rollback(
             variables0, agg, extras, total, server_state, rng, server_update)
+        if lens:
+            # fedlens on the mesh: per-shard norms/dots against the GLOBAL
+            # raw weighted-mean update (its own f32 psum — the agg above is
+            # post-client_transform and dtype-cast, deliberately not reused
+            # so robust clipping can't hide an attacker and the alignment
+            # definition matches obs/lens.stacked_lens bit-for-bit in sim).
+            # Output-only: nothing below feeds new_vars/new_state, so an
+            # armed program aggregates bit-identically.
+            f32 = jnp.float32
+            upd = jax.tree.leaves(jax.tree.map(
+                lambda s, v: s.astype(f32) - v.astype(f32)[None],
+                res.variables["params"], variables0["params"]))
+            n = upd[0].shape[0]
+            flat = [u.reshape((n, -1)) for u in upd]
+            n2 = sum(jnp.sum(u * u, axis=1) for u in flat)
+            wb = w.reshape((-1, 1)).astype(f32)
+            mean = [jax.lax.psum(jnp.sum(u * wb, axis=0), axis) / denom
+                    for u in flat]
+            m2 = sum(jnp.sum(m * m) for m in mean)
+            dots = sum(u @ m for u, m in zip(flat, mean))
+            norm = jnp.sqrt(n2)
+            ldict = {"update_norm": norm,
+                     "align": dots / jnp.maximum(norm * jnp.sqrt(m2), 1e-12)}
+            first = getattr(res, "first_loss", None)
+            if first is not None:
+                ldict["loss_delta"] = (first.astype(f32)
+                                       - res.train_loss.astype(f32))
+            return new_vars, new_state, loss, ldict
         return new_vars, new_state, loss
 
     return finish

@@ -83,6 +83,11 @@ class LocalResult(NamedTuple):
     variables: dict       # updated model variables (params [+ batch_stats])
     train_loss: jax.Array  # mean loss over the last epoch
     tau: jax.Array         # number of optimizer steps taken (FedNova)
+    #: mean loss over the FIRST local epoch (the fedlens loss-delta basis:
+    #: first - last > 0 means local training still makes progress). Optional
+    #: so existing positional LocalResult(...) constructions keep working;
+    #: jit dead-code-eliminates it wherever the lens is off.
+    first_loss: Optional[jax.Array] = None
 
 
 def make_batch_sgd_step(
@@ -230,7 +235,7 @@ def make_local_train_fn(
             epoch_fn, (variables, opt_state), ekeys
         )
         tau = (epochs * steps_real).astype(jnp.float32)
-        return LocalResult(variables, ep_losses[-1], tau)
+        return LocalResult(variables, ep_losses[-1], tau, ep_losses[0])
 
     return local_train
 

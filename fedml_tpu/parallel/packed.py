@@ -169,6 +169,7 @@ def make_lane_train(
     scan_unroll: int = 1,
     client_transform: Optional[Callable] = None,
     reduce_extras: Optional[Callable] = None,
+    lens: bool = False,
 ) -> Callable:
     """Build the single-lane program both execution forms share: the
     simulation paradigm vmaps it over all lanes
@@ -207,13 +208,16 @@ def make_lane_train(
 
         def step_fn(carry, xs):
             (variables, opt_state, loss_acc, acc_vars, acc_w, acc_loss,
-             acc_tau, acc_extras) = carry
+             acc_tau, acc_extras) = carry[:8]
             k, e, s, rs, em, lv = xs
             variables = jax.tree.map(
                 lambda v, z: jnp.where(rs > 0, z, v), variables, variables0)
             opt_state = jax.tree.map(
                 lambda v, z: jnp.where(rs > 0, z, v), opt_state, opt_state0)
             loss_acc = jnp.where(rs > 0, 0.0, loss_acc)
+            if lens:
+                upd_stack, l_first, l_last, floss_acc = carry[8]
+                floss_acc = jnp.where(rs > 0, 0.0, floss_acc)
 
             row = member_row[k]
             oseg = jax.lax.dynamic_slice(
@@ -243,6 +247,20 @@ def make_lane_train(
 
             w = member_w[k] * em
             sr = jnp.maximum(steps_real[k].astype(jnp.float32), 1.0)
+            if lens:
+                # fedlens member scatter (obs/lens.py): each member emits
+                # exactly once, so .add at its slot is a masked set, and
+                # off-emit steps (em = 0) contribute exactly nothing — the
+                # same linear-in-w contract the accumulators above rely on.
+                # RAW update (pre-client_transform): a robust clip must not
+                # hide the attacker from the lens.
+                floss_acc = floss_acc + l * lv * (e == 0).astype(jnp.float32)
+                upd_stack = jax.tree.map(
+                    lambda b, v, p: b.at[k].add(
+                        em * (v.astype(jnp.float32) - p.astype(jnp.float32))),
+                    upd_stack, out_vars["params"], params0)
+                l_first = l_first.at[k].add(em * floss_acc / sr)
+                l_last = l_last.at[k].add(em * loss_acc / sr)
             acc_out = out_vars
             if client_transform is not None:
                 # hook contract is stacked-clients; singleton axis at emit
@@ -270,8 +288,11 @@ def make_lane_train(
                 # traffic than it saves.
                 ex = reduce_extras(variables0, res1, w[None])
                 acc_extras = jax.tree.map(lambda a, b: a + b, acc_extras, ex)
-            return (out_vars, new_opt, loss_acc, acc_vars, acc_w, acc_loss,
-                    acc_tau, acc_extras), None
+            out = (out_vars, new_opt, loss_acc, acc_vars, acc_w, acc_loss,
+                   acc_tau, acc_extras)
+            if lens:
+                out = out + ((upd_stack, l_first, l_last, floss_acc),)
+            return out, None
 
         # zeros DERIVED from inputs, not constants: under shard_map the
         # inputs are device-varying, and a constant-zero carry init would
@@ -288,11 +309,24 @@ def make_lane_train(
         else:
             acc_extras0 = {}
         carry0 = (variables0, opt_state0, z, acc0, z, z, z, acc_extras0)
-        (_, _, _, acc_vars, acc_w, acc_loss, acc_tau, acc_extras), _ = \
-            jax.lax.scan(
-                step_fn, carry0, (slot, epoch_a, sie, reset, emit, live),
-                unroll=max(int(scan_unroll), 1),
-            )
+        if lens:
+            # zeros derived from inputs (shard_map type consistency): the
+            # per-member update stack [k_max, *param] plus first/last mean
+            # losses [k_max]; same memory class as the vmap fallback's
+            # stacked per-client variables
+            zk = member_w * 0.0
+            upd0 = jax.tree.map(
+                lambda p: zk.reshape(zk.shape + (1,) * p.ndim)
+                * p.astype(jnp.float32)[None], params0)
+            carry0 = carry0 + ((upd0, zk, zk, z),)
+        final, _ = jax.lax.scan(
+            step_fn, carry0, (slot, epoch_a, sie, reset, emit, live),
+            unroll=max(int(scan_unroll), 1),
+        )
+        (_, _, _, acc_vars, acc_w, acc_loss, acc_tau, acc_extras) = final[:8]
+        if lens:
+            return (acc_vars, acc_w, acc_loss, acc_tau, acc_extras,
+                    final[8][:3])
         return acc_vars, acc_w, acc_loss, acc_tau, acc_extras
 
     return lane_train
@@ -457,6 +491,7 @@ def make_packed_lanes_train(
     scan_unroll: int = 1,
     client_transform: Optional[Callable] = None,
     reduce_extras: Optional[Callable] = None,
+    lens: bool = False,
 ) -> Callable:
     """The fedpack JOINT form of ``vmap(lane_train)``: all lanes advance
     through ONE scan whose per-step model apply sees the stacked lane axis
@@ -575,7 +610,7 @@ def make_packed_lanes_train(
 
         def step_fn(carry, xs):
             (svars, sopt, loss_acc, acc_vars, acc_w, acc_loss, acc_tau,
-             acc_extras) = carry
+             acc_extras) = carry[:8]
             k, e, s, rs, em, lv = xs                    # each [L]
             svars = jax.tree.map(
                 lambda v, z: jnp.where(bcast(rs, v) > 0, z, v), svars, stack0)
@@ -583,6 +618,9 @@ def make_packed_lanes_train(
                 lambda v, z: jnp.where(bcast(rs, v) > 0, z, v),
                 sopt, opt_state0)
             loss_acc = jnp.where(rs > 0, 0.0, loss_acc)
+            if lens:
+                upd_stack, l_first, l_last, floss_acc = carry[8]
+                floss_acc = jnp.where(rs > 0, 0.0, floss_acc)
 
             rows = jnp.take_along_axis(member_row, k[:, None], axis=1)[:, 0]
             oseg = jax.vmap(
@@ -617,6 +655,20 @@ def make_packed_lanes_train(
             sr = jnp.maximum(jnp.take_along_axis(
                 steps_real, k[:, None], axis=1)[:, 0].astype(jnp.float32),
                 1.0)
+            if lens:
+                # fedlens member scatter, joint form: lane l's member k[l]
+                # slot takes the masked set (each member emits once); same
+                # RAW-update/linear-in-emit contract as the vmap lane form
+                floss_acc = (floss_acc
+                             + per_lane * lv * (e == 0).astype(jnp.float32))
+                lidx = jnp.arange(k.shape[0])
+                upd_stack = jax.tree.map(
+                    lambda b, v, p: b.at[lidx, k].add(
+                        bcast(em, v)
+                        * (v.astype(jnp.float32) - p.astype(jnp.float32))),
+                    upd_stack, out_vars["params"], sparams0)
+                l_first = l_first.at[lidx, k].add(em * floss_acc / sr)
+                l_last = l_last.at[lidx, k].add(em * loss_acc / sr)
             acc_out = out_vars
             if client_transform is not None:
                 # the hook contract is stacked-clients; the joint form IS
@@ -635,8 +687,11 @@ def make_packed_lanes_train(
                 ex = reduce_extras(variables0, res, w)
                 acc_extras = jax.tree.map(
                     lambda a, b: a + b, acc_extras, ex)
-            return (out_vars, new_opt, loss_acc, acc_vars, acc_w, acc_loss,
-                    acc_tau, acc_extras), None
+            out = (out_vars, new_opt, loss_acc, acc_vars, acc_w, acc_loss,
+                   acc_tau, acc_extras)
+            if lens:
+                out = out + ((upd_stack, l_first, l_last, floss_acc),)
+            return out, None
 
         # zeros DERIVED from inputs (shard_map type consistency, as in the
         # vmap form)
@@ -651,15 +706,25 @@ def make_packed_lanes_train(
         else:
             acc_extras0 = {}
         carry0 = (stack0, opt_state0, zl, acc0, zl, zl, zl, acc_extras0)
-        (_, _, _, acc_vars, acc_w, acc_loss, acc_tau, acc_extras), _ = \
-            jax.lax.scan(
-                step_fn, carry0,
-                (slot.T, epoch_a.T, sie.T, reset.T, emit.T, live.T),
-                unroll=max(int(scan_unroll), 1),
-            )
+        if lens:
+            zk2 = member_w * 0.0                        # [L, k_max]
+            upd0 = jax.tree.map(
+                lambda p: zk2.reshape(zk2.shape + (1,) * (p.ndim - 1))
+                * p.astype(jnp.float32)[:, None], sparams0)
+            carry0 = carry0 + ((upd0, zk2, zk2, zl),)
+        final, _ = jax.lax.scan(
+            step_fn, carry0,
+            (slot.T, epoch_a.T, sie.T, reset.T, emit.T, live.T),
+            unroll=max(int(scan_unroll), 1),
+        )
+        (_, _, _, acc_vars, acc_w, acc_loss, acc_tau, acc_extras) = final[:8]
         # singleton lane axis on the extras: the hook summed lanes already,
         # and the caller's sum(axis=0) must reduce THIS axis, not a real one
         acc_extras = jax.tree.map(lambda e: e[None], acc_extras)
+        if lens:
+            # [L, k_max, ...] member stacks — the exact shapes the vmapped
+            # lane form returns, so callers handle both forms identically
+            return acc_vars, acc_w, acc_loss, acc_tau, acc_extras, final[8][:3]
         return acc_vars, acc_w, acc_loss, acc_tau, acc_extras
 
     return lanes_train
@@ -727,13 +792,22 @@ def make_packed_cohort_train(
         lanes = lanes_fn(variables, x_flat, y_flat, m_flat, tm,
                          member_row, member_keys, member_w, steps_real,
                          slot, epoch_a, sie, reset, emit, live)
+        lens_out = None
+        if len(lanes) == 6:                          # fedlens member stacks
+            lens_out = lanes[5]
+            lanes = lanes[:5]
         acc_vars, acc_w, acc_loss, acc_tau, extras = lanes
         # extras: [L] stacked (vmap form) or singleton-axis (joint form) —
         # sum(axis=0) reduces either to the cohort partial sums the
         # server_update hook consumes
-        return (jax.tree.map(lambda a: jnp.sum(a, axis=0), acc_vars),
-                jnp.sum(acc_w), jnp.sum(acc_loss), jnp.sum(acc_tau),
-                jax.tree.map(lambda e: jnp.sum(e, axis=0), extras))
+        out = (jax.tree.map(lambda a: jnp.sum(a, axis=0), acc_vars),
+               jnp.sum(acc_w), jnp.sum(acc_loss), jnp.sum(acc_tau),
+               jax.tree.map(lambda e: jnp.sum(e, axis=0), extras))
+        if lens_out is not None:
+            # per-member stacks stay UNsummed ([L, k_max, ...], member_pos
+            # order) + the matching member weights for the alignment basis
+            out = out + (lens_out + (member_w,),)
+        return out
 
     return packed_train
 

@@ -150,6 +150,19 @@ def pytest_sessionfinish(session, exitstatus):
                  f"{st['overhead_budget_pct']:g}%)")
     except Exception:
         pass
+    # fedlens session digest: one line when any test folded a learning
+    # round — a silent drop of lens coverage (the bit-identity, parity
+    # and attribution tests all fold) shows up in the tier-1 log itself
+    try:
+        from fedml_tpu.obs.lens import session_stats as lens_stats
+
+        st = lens_stats()
+        if st["folds"]:
+            emit(f"[t1] lens: {st['folds']} learning fold(s), "
+                 f"{st['clients']} client observation(s), "
+                 f"{st['suspects']} suspect(s) ranked this session")
+    except Exception:
+        pass
     # fedflight session digest: always emitted — a green run expects 0
     # incident bundles from tests that did not mean to trigger one (the
     # flight tests use tmp_path recorders and DO count here; their

@@ -1,0 +1,452 @@
+"""fedlens (obs/lens + the round programs' learning-signal lane): the
+ISSUE 20 acceptance surface.
+
+Pinned contracts:
+- a lens-on run is bit-identical to a lens-off run — sim AND a 4-rank
+  grpc edge federation (the lens adds output-only reductions; nothing
+  feeds the aggregate);
+- the packed round form computes the SAME lens values as the gather/vmap
+  form, at fedseg tolerance (accumulation order differs, nothing else);
+- ``fold_rows``/``rank_suspects`` are deterministic and keep each
+  client's WORST observation;
+- the three watchdog rules (``update_norm_spike``, ``client_drift``,
+  ``aligned_suspects``) fire on their signals and every event carries
+  the suspect client ids;
+- a seeded ``robust.py`` backdoor federation escalates with the injected
+  attacker's logical id topping the ``aligned_suspects`` ranking, the
+  incident bundle carries the lens lane, and ``fedpost`` renders the
+  suspects section from the bundle directory alone;
+- ``fedtop --once`` over a committed lens-armed fixture is golden.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu import obs
+from fedml_tpu.core.config import FedConfig
+from fedml_tpu.data import load_dataset
+from fedml_tpu.data.synthetic import make_synthetic_classification
+from fedml_tpu.distributed.fedavg_edge import run_fedavg_edge
+from fedml_tpu.obs import lens
+from fedml_tpu.obs.health import FederationHealthError, HealthWatchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "pulse")
+
+#: packed-vs-vmap lens tolerance: the fedseg accumulation-order bound
+PARITY_TOL = 5e-4
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs():
+    """The lens flag and pulse plane are process-global — never leak them
+    into later tests (the test_pulse precedent)."""
+    obs.reset()
+    yield
+    obs.reset()
+    import gc
+
+    gc.collect()
+
+
+def _snaps(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+# -- config flags -----------------------------------------------------------
+
+def test_lens_config_validation():
+    with pytest.raises(ValueError, match="lens must be"):
+        FedConfig(lens="maybe")
+    with pytest.raises(ValueError, match="lens_topk"):
+        FedConfig(lens_topk=0)
+    with pytest.raises(ValueError, match="health_update_norm"):
+        FedConfig(health_update_norm=-1.0)
+    with pytest.raises(ValueError, match="health_drift"):
+        FedConfig(health_drift=-0.5)
+    c = FedConfig(lens="on", lens_topk=3, health_update_norm=2.0,
+                  health_drift=1.1)
+    assert c.lens == "on" and c.lens_topk == 3
+
+
+def test_lens_cli_flags_roundtrip():
+    from fedml_tpu.core.config import add_args
+
+    ns = add_args().parse_args(
+        ["--lens", "on", "--lens_topk", "7",
+         "--health_update_norm", "3.5", "--health_drift", "1.2"])
+    assert ns.lens == "on" and ns.lens_topk == 7
+    assert ns.health_update_norm == 3.5 and ns.health_drift == 1.2
+
+
+def test_configure_from_is_authoritative_only_when_present():
+    lens.configure(True, topk=9)
+    assert lens.configure_from(object()) is True      # no attr: untouched
+    assert lens.lens_topk() == 9
+    assert lens.configure_from(FedConfig(lens="off")) is False
+    assert lens.configure_from(FedConfig(lens="on", lens_topk=2)) is True
+    assert lens.lens_topk() == 2
+
+
+# -- bit-identity: sim ------------------------------------------------------
+
+def _sim_run(tmp_path, tag, lens_mode):
+    obs.reset()
+    ds = make_synthetic_classification(
+        "lens-sim", (6,), 3, 4, records_per_client=8,
+        partition_method="homo", batch_size=4, seed=0)
+    path = str(tmp_path / f"pulse-{tag}.jsonl")
+    cfg = FedConfig(model="lr", client_num_in_total=4,
+                    client_num_per_round=4, comm_round=3, epochs=2,
+                    batch_size=4, lr=0.1, frequency_of_the_test=1,
+                    pulse_path=path, lens=lens_mode)
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    api = FedAvgAPI(ds, cfg)
+    hist = api.train()
+    return hist, api, path
+
+
+def test_lens_sim_bit_identical_and_learning_block(tmp_path):
+    """The acceptance bit-identity (sim half): same weights and losses
+    with the lens armed, and only the armed stream carries ``learning``."""
+    on_hist, on_api, on_path = _sim_run(tmp_path, "on", "on")
+    off_hist, off_api, off_path = _sim_run(tmp_path, "off", "off")
+    assert on_hist["Test/Loss"] == off_hist["Test/Loss"]
+    assert on_hist["Test/Acc"] == off_hist["Test/Acc"]
+    for a, b in zip(jax.tree.leaves(on_api.variables),
+                    jax.tree.leaves(off_api.variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    on_snaps, off_snaps = _snaps(on_path), _snaps(off_path)
+    assert all("learning" not in s for s in off_snaps)
+    assert all(s["learning"]["clients"] == 4 for s in on_snaps)
+    # every suspect carries the full attribution tuple (epochs=2 makes
+    # loss_delta real, the sim stash keeps align for every client)
+    for s in on_snaps:
+        for sus in s["learning"]["suspects"]:
+            assert {"client", "norm", "align", "drift",
+                    "loss_delta"} <= set(sus)
+    # the profiler folded the lens lanes as per-round sketch deltas
+    sk = on_snaps[-1]["sketches"]
+    assert sk["update_norm"]["count"] == 4 * 3
+    assert sk["drift"]["count"] == 4 * 3
+    assert "update_norm" not in off_snaps[-1]["sketches"]
+
+
+# -- packed vs vmap parity --------------------------------------------------
+
+def test_lens_packed_vs_vmap_value_parity():
+    """The packed round form folds the SAME per-client lens values as the
+    gather/vmap form (fedseg tolerance — accumulation order only). The
+    plane is off: the armed API stashes the device arrays and
+    ``_pulse_lens`` hands them straight to the test."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    def run(pack_lanes):
+        obs.reset()
+        lens.configure(True, topk=8)
+        ds = make_synthetic_classification(
+            "lens-par", (6,), 3, 6, records_per_client=12,
+            partition_method="hetero", partition_alpha=0.5,
+            batch_size=4, seed=1)
+        cfg = FedConfig(model="lr", client_num_in_total=6,
+                        client_num_per_round=6, comm_round=2, epochs=2,
+                        batch_size=4, lr=0.2, seed=7,
+                        frequency_of_the_test=100, pack_lanes=pack_lanes)
+        api = FedAvgAPI(ds, cfg)
+        out = {}
+        for r in range(2):
+            api.run_round(r)
+            rnd, ids, stats = api._pulse_lens(r)
+            assert rnd == r
+            order = np.argsort(ids)
+            out[r] = {k: np.asarray(v)[order] for k, v in stats.items()}
+        return out
+
+    vmap, packed = run(0), run(2)
+    for r in range(2):
+        assert set(vmap[r]) == set(packed[r]) \
+            == {"update_norm", "align", "loss_delta"}
+        for k in vmap[r]:
+            np.testing.assert_allclose(
+                packed[r][k], vmap[r][k], atol=PARITY_TOL, rtol=PARITY_TOL,
+                err_msg=f"round {r} lane {k}")
+
+
+# -- fold_rows / rank_suspects units ----------------------------------------
+
+def test_rank_suspects_orders_drift_norm_id():
+    ids = np.array([5, 3, 9, 1])
+    norm = np.array([1.0, 2.0, 2.0, 0.5])
+    align = np.array([-0.5, 0.1, 0.1, np.nan])
+    delta = np.array([0.2, np.nan, 0.1, 0.3])
+    out = lens.rank_suspects(ids, norm, align, delta, 4)
+    # drift desc, then norm desc, then id asc; nan-align ranks below all
+    assert [s["client"] for s in out] == [5, 3, 9, 1]
+    assert out[0]["drift"] == 1.5 and out[0]["align"] == -0.5
+    assert "align" not in out[3] and "drift" not in out[3]
+    assert "loss_delta" not in out[1] and out[3]["loss_delta"] == 0.3
+    # top-k truncates after dedupe
+    assert len(lens.rank_suspects(ids, norm, align, delta, 2)) == 2
+
+
+def test_fold_rows_keeps_worst_observation_per_client():
+    rows = [
+        {"ids": np.array([1, 2]), "update_norm": np.array([1.0, 1.0]),
+         "align": np.array([0.9, 0.8]), "loss_delta": None},
+        # client 1 re-uploads with a WORSE (anti-aligned) observation
+        {"ids": np.array([1]), "update_norm": np.array([0.5]),
+         "align": np.array([-0.9]), "loss_delta": None},
+    ]
+    out = lens.fold_rows(rows, 5)
+    assert out["clients"] == 2
+    assert out["suspects"][0] == {"client": 1, "norm": 0.5, "align": -0.9,
+                                  "drift": 1.9}
+    # scalar per-row stats broadcast over the row's ids (edge upload form)
+    out = lens.fold_rows(
+        [{"ids": np.array([3, 4]), "update_norm": 2.0, "align": 0.5}], 5)
+    assert [s["norm"] for s in out["suspects"]] == [2.0, 2.0]
+
+
+# -- watchdog rules ---------------------------------------------------------
+
+def _profile(update_norm_sk=None, drift_sk=None, suspects=None):
+    p = {"clients_seen": 8, "sketches": {}}
+    if update_norm_sk:
+        p["sketches"]["update_norm"] = update_norm_sk
+    if drift_sk:
+        p["sketches"]["drift"] = drift_sk
+    if suspects is not None:
+        p["lens"] = {"suspects": suspects}
+    return p
+
+
+def test_watchdog_update_norm_spike_and_client_drift_rules():
+    wd = HealthWatchdog(update_norm=5.0, drift=1.1)
+    # calm round: neither fires
+    assert wd.check_round(0, profile=_profile(
+        {"count": 8, "p50": 1.0, "p99": 2.0},
+        {"count": 8, "p50": 0.1, "p99": 0.5})) == []
+    # THIS round's delta p99 crosses both thresholds; the events carry the
+    # round's ranked suspect ids
+    sus = [{"client": 7, "norm": 9.0, "align": 0.9, "drift": 0.1}]
+    ev = wd.check_round(1, profile=_profile(
+        {"count": 8, "p50": 1.0, "p99": 9.0},
+        {"count": 8, "p50": 0.1, "p99": 1.3}, suspects=sus))
+    assert [e["rule"] for e in ev] == ["update_norm_spike", "client_drift"]
+    assert all(e["severity"] == "warn" and e["suspects"] == [7] for e in ev)
+    # an empty lane (lens-off round: count 0) never fires on a stale p99
+    assert wd.check_round(2, profile=_profile(
+        {"count": 0, "p99": 99.0}, {"count": 0, "p99": 99.0})) == []
+    # rules are armed by their flags: default watchdog ignores the lanes
+    assert HealthWatchdog().check_round(0, profile=_profile(
+        {"count": 8, "p50": 1.0, "p99": 9.0},
+        {"count": 8, "p50": 0.1, "p99": 1.3})) == []
+
+
+def test_watchdog_aligned_suspects_rule_always_armed():
+    wd = HealthWatchdog()   # no lens thresholds: the signature still fires
+    sk = {"count": 4, "p50": 1.0, "p99": 2.0}
+    # anti-aligned AND at/above the cohort median norm -> critical
+    bad = [{"client": 3, "norm": 1.5, "align": -0.6, "drift": 1.6},
+           {"client": 1, "norm": 0.1, "align": -0.9, "drift": 1.9},
+           {"client": 2, "norm": 2.0, "align": 0.8, "drift": 0.2}]
+    ev = wd.check_round(0, profile=_profile(sk, suspects=bad))
+    assert [e["rule"] for e in ev] == ["aligned_suspects"]
+    assert ev[0]["severity"] == "critical"
+    # low-norm client 1 is guarded out; aligned client 2 is not a suspect
+    assert ev[0]["suspects"] == [3]
+    assert "client(s) 3" in ev[0]["detail"]
+    # aligned cohort: silent
+    calm = [{"client": 5, "norm": 1.5, "align": 0.9, "drift": 0.1}]
+    assert HealthWatchdog().check_round(
+        0, profile=_profile(sk, suspects=calm)) == []
+    # no alignment basis (edge streaming folds): never fires on norm alone
+    nb = [{"client": 5, "norm": 99.0}]
+    assert HealthWatchdog().check_round(
+        0, profile=_profile(sk, suspects=nb)) == []
+
+
+def test_second_federation_inherits_no_lens_state(tmp_path):
+    """Process-global hygiene: a fresh plane after a lens-armed federation
+    starts from scratch — no stale suspects, no stale sketch counts, and a
+    lens-off config DISARMS a lens left on by the previous run."""
+    _sim_run(tmp_path, "first", "on")
+    assert lens.lens_enabled()     # armed by the entry-point configure
+    _, _, path = _sim_run(tmp_path, "second", "off")
+    assert not lens.lens_enabled()
+    snaps = _snaps(path)
+    assert all("learning" not in s for s in snaps)
+    assert all("update_norm" not in s["sketches"] for s in snaps)
+
+
+# -- bit-identity: 4-rank grpc edge -----------------------------------------
+
+@pytest.mark.slow  # ~7 s: grpc twin of the sim bit-identity pin
+def test_lens_grpc_edge_4_ranks_bit_identical(tmp_path):
+    """The edge half of the acceptance bit-identity: a 4-rank grpc
+    federation with the lens armed computes exactly the lens-off weights,
+    and the server's snapshots carry per-upload lens attribution."""
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    def run(lens_mode, port, tag):
+        obs.reset()
+        ds = load_dataset("synthetic_1_1", num_clients=4, batch_size=10,
+                          seed=3)
+        path = str(tmp_path / f"pulse-{tag}.jsonl")
+        cfg = FedConfig(
+            model="lr", dataset="synthetic_1_1", client_num_in_total=4,
+            client_num_per_round=4, comm_round=2, batch_size=10, lr=0.1,
+            epochs=1, frequency_of_the_test=1, seed=3, device_data="off",
+            pulse_path=path, lens=lens_mode)
+        agg = run_fedavg_edge(
+            ds, cfg, worker_num=3,
+            comm_factory=lambda r: GRPCCommManager(
+                rank=r, size=4, base_port=port, host="127.0.0.1"))
+        return agg, path
+
+    on, on_path = run("on", 57440, "on")
+    off, off_path = run("off", 57444, "off")
+    assert [h["loss"] for h in on.test_history] \
+        == [h["loss"] for h in off.test_history]
+    for a, b in zip(jax.tree.leaves(on.get_global_model_params()),
+                    jax.tree.leaves(off.get_global_model_params())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    on_snaps, off_snaps = _snaps(on_path), _snaps(off_path)
+    assert all("learning" not in s for s in off_snaps)
+    last = on_snaps[-1]
+    # per-upload lens attribution reached every logical client, and the
+    # batch edge aggregator kept an alignment basis for every suspect
+    assert last["learning"]["clients"] == 4
+    assert all("align" in s for s in last["learning"]["suspects"])
+    assert last["sketches"]["update_norm"]["count"] == 8   # 4 clients x 2
+    assert last["sketches"]["drift"]["count"] == 8
+
+
+# -- the e2e attribution pin: seeded backdoor -> named attacker -------------
+
+def _backdoor_federation(tmp_path, *, lens_mode="on", escalate=True):
+    """A seeded 12-client binary federation with one backdoor attacker
+    (robust.py): the attacker's local records are class-0-only (its
+    relabel-to-1 poison genuinely opposes the homo cohort mean — the
+    anti-aligned signature) with a 1-feature trigger stamp whose update
+    contribution stays small enough not to dominate the aggregate."""
+    from fedml_tpu.algorithms.robust import FedAvgRobustAPI
+    from fedml_tpu.models import create_model
+
+    ds = make_synthetic_classification(
+        "lens-bd6", (30,), 2, 12, records_per_client=16,
+        partition_method="homo", batch_size=8, seed=5)
+    atk = 3
+    tx, ty = np.array(ds.train_x), np.array(ds.train_y)
+    rows0 = np.where(ty[atk] == 0)[0]
+    idx = rows0[np.arange(ty.shape[1]) % len(rows0)]
+    tx[atk], ty[atk] = tx[atk][idx], np.zeros_like(ty[atk])
+    ds = dataclasses.replace(ds, train_x=tx, train_y=ty)
+    cfg = FedConfig(model="lr", client_num_in_total=12,
+                    client_num_per_round=12, comm_round=6, epochs=2,
+                    batch_size=8, lr=0.3, seed=11,
+                    frequency_of_the_test=100, lens=lens_mode, lens_topk=4,
+                    pulse_path=str(tmp_path / "pulse.jsonl"),
+                    flight_dir=str(tmp_path / "flight"),
+                    health_escalate=escalate)
+    obs.reset()
+    api = FedAvgRobustAPI(
+        ds, cfg,
+        create_model("lr", ds.class_num, input_shape=ds.train_x.shape[2:]),
+        attacker_idx=atk, target_class=1, poison_frac=1.0,
+        trigger_value=3.5, trigger_size=1)
+    obs.configure_from(cfg)
+    return api, cfg, atk
+
+
+def test_backdoor_attacker_tops_aligned_suspects_and_bundle(
+        tmp_path, capsys):
+    """The ISSUE 20 e2e: the armed watchdog catches the injected attacker
+    BY LOGICAL ID at the first poisoned round, the escalation-triggered
+    incident bundle carries the lens lane, and fedpost renders the
+    suspects section from the bundle directory alone."""
+    api, cfg, atk = _backdoor_federation(tmp_path)
+    with pytest.raises(FederationHealthError, match="aligned_suspects"):
+        for r in range(cfg.comm_round):
+            api.run_round(r)
+
+    # the snapshot that recorded the kill is on disk and NAMES the attacker
+    snaps = _snaps(str(tmp_path / "pulse.jsonl"))
+    ev = [e for s in snaps for e in s["health"]["events"]
+          if e["rule"] == "aligned_suspects"]
+    assert ev and ev[0]["severity"] == "critical"
+    assert ev[0]["suspects"] == [atk]
+    # ...and the attacker TOPS the lens ranking (worst drift)
+    assert snaps[-1]["learning"]["suspects"][0]["client"] == atk
+    assert snaps[-1]["learning"]["suspects"][0]["align"] <= lens.ANTI_ALIGN
+
+    # the dump-before-raise bundle exists and its compact round records
+    # carry the learning lane (fedpost needs no pulse stream)
+    flight_dir = str(tmp_path / "flight")
+    bundles = [os.path.join(flight_dir, b)
+               for b in sorted(os.listdir(flight_dir))]
+    assert len(bundles) == 1
+    rounds = [json.loads(l)
+              for l in open(os.path.join(bundles[0], "rounds.jsonl"))]
+    assert any(r.get("learning") for r in rounds)
+    wd = json.load(open(os.path.join(bundles[0], "watchdog.json")))
+    assert any(e["rule"] == "aligned_suspects" and e.get("suspects") == [atk]
+               for e in wd["events"])
+
+    # fedpost, from the bundle directory alone: a suspects section whose
+    # first row is the attacker
+    fedpost = _load_tool("fedpost")
+    assert fedpost.main([bundles[0]]) == 0
+    out = capsys.readouterr().out
+    assert "suspect clients (fedlens" in out
+    lines = out[out.index("suspect clients"):].splitlines()
+    assert lines[1].split()[1] == str(atk)
+    assert "aligned_suspects" in out
+
+
+def test_backdoor_run_with_lens_off_is_blind(tmp_path):
+    """The control: the SAME attack with --lens off runs every round to
+    completion — no learning lane, no attribution, no bundle. (This is
+    the observability gap the lens closes; it also pins that the robust
+    clip defense alone never escalates.)"""
+    api, cfg, _ = _backdoor_federation(tmp_path, lens_mode="off")
+    for r in range(cfg.comm_round):
+        api.run_round(r)
+    snaps = _snaps(str(tmp_path / "pulse.jsonl"))
+    assert len(snaps) == cfg.comm_round
+    assert all("learning" not in s for s in snaps)
+    assert not os.path.exists(str(tmp_path / "flight")) \
+        or not os.listdir(str(tmp_path / "flight"))
+
+
+# -- fedtop golden over a committed lens-armed fixture ----------------------
+
+def test_fedtop_once_lens_golden(capsys):
+    """Committed lens-armed fixture in, committed render out: the
+    ``learning`` panel and suspect line are part of the dashboard
+    contract."""
+    fedtop = _load_tool("fedtop")
+    rc = fedtop.main([os.path.join(FIXTURES, "pulse_lens.jsonl"), "--once"])
+    out = capsys.readouterr().out
+    golden = open(os.path.join(FIXTURES, "fedtop_lens.txt")).read()
+    assert rc == 0
+    assert out == golden
+    assert "learning" in out and "suspects" in out

@@ -48,6 +48,14 @@ def _sketch(j: dict, lane: str, q: str):
             .get(lane) or {}).get(q)
 
 
+def _lens(j: dict, lane: str, q: str):
+    """Missing-key-tolerant reach into the tail's fedlens block (bench.py
+    arms the lens for the measured pass); falls back to the profiler
+    sketch lanes, None on pre-lens artifacts (r01-r07) -> "-"."""
+    v = ((j.get("lens") or {}).get(lane) or {}).get(q)
+    return v if v is not None else _sketch(j, lane, q)
+
+
 #: metric -> (extractor over the bench JSON, short label, gated). Gated
 #: metrics are higher-is-better; regression = relative drop beyond the
 #: threshold. gated=False rows are TRAJECTORY-ONLY columns (the fedsketch
@@ -134,6 +142,17 @@ METRICS = {
         lambda j: ((j.get("packed_conv") or {}).get("plan") or {})
         .get("summary"),
         "plan", False),
+    # fedlens (ISSUE 20): the learning-signal distribution tails at the
+    # flagship operating point — p99 raw-update norm, p99 drift (1 -
+    # cosine vs the round aggregate; higher = clients pulling against
+    # it). Both read with the data-heterogeneity/lr context, never as a
+    # bare regression — trajectory-only. Absent on r01-r07 artifacts
+    # (chained .get()s return None -> "-"; missing keys never flake the
+    # gate).
+    "lens_update_norm_p99": (
+        lambda j: _lens(j, "update_norm", "p99"), "p99 update norm", False),
+    "lens_drift_p99": (
+        lambda j: _lens(j, "drift", "p99"), "drift p99", False),
     # fedsched (ISSUE 13): the cross-device block's cohort size and cohort
     # policy — context columns for the clients/s trajectory (the r06 jump
     # reads as "1000-client scheduled cohorts", not as free speed). Absent

@@ -21,6 +21,11 @@ The verdict it renders:
   straggler attribution across the window;
 - **round window** — the retained rounds' loss / wall / health state
   and notable per-round counter-lane deltas (``rounds.jsonl``);
+- **suspect clients** — when the run was lens-armed (``--lens on``) the
+  round records carry the fedlens ``learning`` lane; fedpost merges the
+  per-round suspect rankings across the window (each client keeps its
+  worst drift/norm observation) and names the logical client ids most
+  likely behind a learning-signal incident — from the bundle alone;
 - **replay** — the exact command the manifest carries: the run is pure
   in (seed, chaos_seed, flags), so the command reproduces the incident.
 
@@ -128,6 +133,7 @@ def build_verdict(b: dict) -> dict:
         "baseline_deltas": wd.get("baseline_deltas") or {},
         "rounds": b["rounds"],
     }
+    v["suspects"] = collect_suspects(b["rounds"])
     if has_span_events(b["events"]):
         rep = analyze(b["events"])
         # the incident round's timeline entry when the rings kept it,
@@ -148,6 +154,50 @@ def build_verdict(b: dict) -> dict:
     else:
         v["chain"] = None
     return v
+
+
+def collect_suspects(rounds: list) -> list:
+    """Merge the fedlens suspect rankings across the retained window:
+    each client keeps its WORST observation (highest drift, then highest
+    norm — a client that looked fine for five rounds and anti-aligned on
+    the sixth is ranked by the sixth), tagged with how many retained
+    rounds ranked it. Deterministic: ties break on client id ascending.
+    Empty on lens-off bundles — the section is absent and every pre-lens
+    golden holds byte-identically."""
+    worst: dict = {}
+    seen: dict = {}
+    for r in rounds:
+        for s in (r.get("learning") or {}).get("suspects") or []:
+            if not isinstance(s, dict) or "client" not in s:
+                continue
+            cid = int(s["client"])
+            seen[cid] = seen.get(cid, 0) + 1
+            key = (s["drift"] if isinstance(s.get("drift"), (int, float))
+                   else float("-inf"), float(s.get("norm") or 0.0))
+            if cid not in worst or key > worst[cid][0]:
+                worst[cid] = (key, s)
+    out = []
+    for cid, (_, s) in worst.items():
+        e = dict(s)
+        e["client"] = cid
+        e["rounds"] = seen[cid]
+        out.append(e)
+    out.sort(key=lambda e: (
+        -(e["drift"] if isinstance(e.get("drift"), (int, float))
+          else float("-inf")),
+        -float(e.get("norm") or 0.0), e["client"]))
+    return out
+
+
+def _fmt_suspect(s: dict) -> str:
+    row = f"client {s['client']!s:>5}  norm {s.get('norm', 0):g}"
+    if s.get("drift") is not None:
+        row += f"  drift {s['drift']:g}"
+    if s.get("align") is not None:
+        row += f"  align {s['align']:g}"
+    if s.get("loss_delta") is not None:
+        row += f"  dloss {s['loss_delta']:g}"
+    return row + f"  in {s['rounds']} round(s)"
 
 
 def _fmt_chain_entry(e: dict) -> list:
@@ -238,6 +288,10 @@ def render_text(v: dict) -> str:
         deltas = _notable_deltas(v)
         if deltas:
             lines.append("  notable lane deltas: " + ", ".join(deltas))
+    if v.get("suspects"):
+        lines.append("")
+        lines.append("suspect clients (fedlens, worst over the window):")
+        lines.extend("  " + _fmt_suspect(s) for s in v["suspects"][:8])
     lines.append("")
     lines.append("replay:")
     lines.append(f"  {v['replay_cmd'] or '(manifest carries no command)'}")
@@ -288,6 +342,17 @@ def render_markdown(v: dict) -> str:
             lines.append("")
             lines.append("Notable lane deltas: "
                          + ", ".join(f"`{d}`" for d in deltas))
+    if v.get("suspects"):
+        lines += ["", "## Suspect clients (fedlens)", "",
+                  "| client | norm | drift | align | dloss | rounds |",
+                  "| --- | --- | --- | --- | --- | --- |"]
+        for s in v["suspects"][:8]:
+            def _c(k):
+                return (f"{s[k]:g}" if isinstance(s.get(k), (int, float))
+                        else "-")
+            lines.append(f"| {s['client']} | {_c('norm')} | {_c('drift')} | "
+                         f"{_c('align')} | {_c('loss_delta')} | "
+                         f"{s['rounds']} |")
     lines += ["", "## Replay", "", "```sh",
               v["replay_cmd"] or "# manifest carries no command", "```"]
     return "\n".join(lines)

@@ -6,7 +6,9 @@ and renders the federation's live state: round progress and rates
 (rounds/s, clients/s), train/eval loss, MAC-basis MFU against the fedcost
 lane ceiling, wire anomalies, the per-client profile summary with the
 top-k stragglers, the fedsketch percentile lanes (train/upload/payload
-p50/p90/p99) with the rounds-behind staleness spread, and the health
+p50/p90/p99) with the rounds-behind staleness spread, the fedlens
+``learning`` panel (update-norm/drift percentiles + the round's ranked
+suspect client ids — only on ``--lens on`` streams), and the health
 watchdog's verdict:
 
     python tools/fedtop.py /tmp/run/pulse.jsonl            # live (1s poll)
@@ -315,6 +317,31 @@ def render(snaps: list[dict], path: str, stalled_s: float = 0.0) -> str:
             lines.append(f"            {label} {_pct(s, unit)}")
     if "staleness" in sk:
         lines.append("staleness : " + _pct(sk["staleness"], " rounds behind"))
+    # fedlens learning panel (absent on lens-off streams, so every
+    # pre-lens fixture renders byte-identically)
+    learning = last.get("learning") or {}
+    if learning or "update_norm" in sk or "drift" in sk:
+        bits = []
+        if learning.get("clients"):
+            bits.append(f"{learning['clients']} client(s)")
+        un = sk.get("update_norm")
+        if un:
+            bits.append(f"upd norm p50 {un.get('p50', 0):g}"
+                        f" / p99 {un.get('p99', 0):g}")
+        dr = sk.get("drift")
+        if dr:
+            bits.append(f"drift p99 {dr.get('p99', 0):g}")
+        lines.append("learning  : " + (" · ".join(bits) if bits else "n/a"))
+        sus = learning.get("suspects") or []
+        if sus:
+            lines.append("suspects  : " + " · ".join(
+                f"#{s['client']}"
+                + (f" drift {s['drift']:g}" if s.get("drift") is not None
+                   else "")
+                + f" norm {s.get('norm', 0):g}"
+                + (f" Δloss {s['loss_delta']:g}"
+                   if s.get("loss_delta") is not None else "")
+                for s in sus))
     events = [e for s in snaps
               for e in (s.get("health") or {}).get("events", ())]
     if events:

@@ -16,9 +16,11 @@ line, failure/error names, the slowest-10 test files, the compile-cache
 line, the plan-cache line (fedplan candidate micro-lowering hits/misses),
 the obs-overhead line (the pinned full-plane-on vs off wall
 delta from the fedsketch budget test), the fedlint line (rule count
-plus unsuppressed/suppressed finding counts over the real tree), and the
-incidents line (fedflight bundles dumped during the session — a green
-run's count is stable: only the flight tests' own expected dumps).
+plus unsuppressed/suppressed finding counts over the real tree), the
+lens line (fedlens learning folds / client observations / suspects
+ranked during the session), and the incidents line (fedflight bundles
+dumped during the session — a green run's count is stable: only the
+flight tests' own expected dumps).
 ``--json`` emits the same as one JSON object.
 
 Exit codes: 0 parsed; 2 when the file has no pytest progress output at all
@@ -50,6 +52,7 @@ CACHE_RE = re.compile(r"^\[t1\] compile-cache: (.*)$")
 PLAN_CACHE_RE = re.compile(r"^\[t1\] plan-cache: (.*)$")
 OBS_OVERHEAD_RE = re.compile(r"^\[t1\] obs-overhead: (.*)$")
 FEDLINT_RE = re.compile(r"^\[t1\] fedlint: (.*)$")
+LENS_RE = re.compile(r"^\[t1\] lens: (.*)$")
 INCIDENTS_RE = re.compile(r"^\[t1\] incidents: (.*)$")
 
 
@@ -63,6 +66,7 @@ def parse_log(text: str) -> dict:
     plan_cache = None
     obs_overhead = None
     fedlint = None
+    lens = None
     incidents = None
     for line in text.splitlines():
         line = line.rstrip()
@@ -100,6 +104,10 @@ def parse_log(text: str) -> dict:
         if m:
             fedlint = m.group(1)
             continue
+        m = LENS_RE.match(line)
+        if m:
+            lens = m.group(1)
+            continue
         m = INCIDENTS_RE.match(line)
         if m:
             incidents = m.group(1)
@@ -116,6 +124,7 @@ def parse_log(text: str) -> dict:
         "plan_cache": plan_cache,
         "obs_overhead": obs_overhead,
         "fedlint": fedlint,
+        "lens": lens,
         "incidents": incidents,
     }
 
@@ -140,6 +149,8 @@ def format_report(rep: dict) -> str:
         lines.append(f"obs-overhead: {rep['obs_overhead']}")
     if rep.get("fedlint"):
         lines.append(f"fedlint: {rep['fedlint']}")
+    if rep.get("lens"):
+        lines.append(f"lens: {rep['lens']}")
     if rep.get("incidents"):
         lines.append(f"incidents: {rep['incidents']}")
     if rep["slowest_files"]:

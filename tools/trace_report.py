@@ -45,8 +45,12 @@ Report sections:
   directory contributes its last snapshot's mergeable lane encodings
   (sketches are run-cumulative); the lanes fold ACROSS hosts with the
   exact order-independent merge, so a multi-host run's p50/p90/p99
-  train-ms / upload-latency / payload / staleness read as one
-  distribution. Streams without sketches add nothing.
+  train-ms / upload-latency / payload / staleness — and, on lens-armed
+  runs (``--lens on``), the fedlens ``update_norm`` / ``drift`` learning
+  lanes — read as one distribution. Streams without sketches add
+  nothing; a lane that fails to decode (an unknown or corrupt encoding
+  from a newer/older host) is skipped with a stderr note, never an exit
+  code change.
 
 ``--incident <bundle>`` swaps the input for a fedflight ``incident-<id>/``
 bundle: the per-rank flight-ring dumps (full-rate capture of the last
